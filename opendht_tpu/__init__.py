@@ -1,0 +1,23 @@
+"""opendht_tpu — a TPU-native distributed hash table framework.
+
+A ground-up re-design of the OpenDHT capability set (Kademlia DHT with
+values, listen/pub-sub, public-key crypto layer, PHT secondary index,
+runner/threading runtime, CLI tools, and a test/benchmark harness) built
+TPU-first:
+
+* the event-driven host runtime (``core``, ``net``, ``crypto``,
+  ``indexation``) mirrors the reference's layer seams so a deterministic
+  in-memory transport slots in where UDP does;
+* the device path (``ops``, ``parallel``, ``models``) implements the
+  160-bit XOR metric, k-bucket routing construction, and massively
+  batched iterative Kademlia lookups as JAX/Pallas kernels over packed
+  ``[N, 5] uint32`` id matrices, sharded over a ``jax.sharding.Mesh``.
+
+Reference: sim590/opendht (C++11), see SURVEY.md.
+"""
+
+__version__ = "0.1.0"
+
+from .utils.infohash import InfoHash  # noqa: F401
+from .utils.sockaddr import SockAddr  # noqa: F401
+from .core.value import Value, ValueType, Query, Select, Where  # noqa: F401

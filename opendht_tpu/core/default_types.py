@@ -1,0 +1,100 @@
+"""Built-in value types 0-8.
+
+Re-design of the reference default types (ref:
+include/opendht/default_types.h, src/default_types.cpp:86-106 type table):
+
+* 0 USER_DATA      — plain user bytes, 10 min TTL
+* 1 DhtMessage     — service messages, 5 min TTL
+* 2 IpServiceAnnouncement — service endpoint; store policy rewrites the
+  stored address to the sender's observed address (src/default_types.cpp:70-84)
+* 3 ImMessage      — instant messages (used by dhtchat)
+* 4 TrustRequest
+* 5 IceCandidates
+* 8 CERTIFICATE    — 7-day TTL, only storable at its own key id
+  (ref: include/opendht/securedht.h:166-183)
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+from ..utils.sockaddr import SockAddr
+from .value import Value, ValueType, default_store_policy
+
+
+class DhtMessage:
+    TYPE = ValueType(1, "DHT message", 5 * 60)
+
+    def __init__(self, service: str = "", data: bytes = b""):
+        self.service = service
+        self.data = data
+
+    def pack(self) -> bytes:
+        return msgpack.packb({"s": self.service, "d": self.data})
+
+    @classmethod
+    def unpack(cls, blob: bytes) -> "DhtMessage":
+        o = msgpack.unpackb(blob, raw=False)
+        return cls(o.get("s", ""), bytes(o.get("d", b"")))
+
+
+def _ip_service_store_policy(value: Value, remote_id, from_addr) -> bool:
+    """Rewrite announced address to the sender's observed address
+    (ref: src/default_types.cpp:70-84)."""
+    if not default_store_policy(value, remote_id, from_addr):
+        return False
+    try:
+        ann = IpServiceAnnouncement.unpack(value.data)
+    except Exception:
+        return False
+    if not ann.addr.host and isinstance(from_addr, SockAddr):
+        ann.addr = SockAddr(from_addr.host, ann.addr.port or from_addr.port)
+        value.data = ann.pack()
+    return True
+
+
+class IpServiceAnnouncement:
+    TYPE = ValueType(2, "Internet Service Announcement", 15 * 60,
+                     store_policy=_ip_service_store_policy)
+
+    def __init__(self, addr: SockAddr = None):
+        self.addr = addr or SockAddr()
+
+    def pack(self) -> bytes:
+        return msgpack.packb({"h": self.addr.host, "p": self.addr.port})
+
+    @classmethod
+    def unpack(cls, blob: bytes) -> "IpServiceAnnouncement":
+        o = msgpack.unpackb(blob, raw=False)
+        return cls(SockAddr(o.get("h", ""), o.get("p", 0)))
+
+
+class ImMessage:
+    TYPE = ValueType(3, "IM message", 100 * 24 * 3600)
+
+    def __init__(self, msg_id: int = 0, message: str = "", date: int = 0):
+        self.id = msg_id
+        self.message = message
+        self.date = date
+
+    def pack(self) -> bytes:
+        return msgpack.packb({"id": self.id, "im": self.message,
+                              "d": self.date})
+
+    @classmethod
+    def unpack(cls, blob: bytes) -> "ImMessage":
+        o = msgpack.unpackb(blob, raw=False, strict_map_key=False)
+        return cls(o.get("id", 0), o.get("im", ""), o.get("d", 0))
+
+
+TRUST_REQUEST = ValueType(4, "Certificate trust request", 100 * 24 * 3600)
+ICE_CANDIDATES = ValueType(5, "ICE candidates", 10 * 60)
+CERTIFICATE_TYPE_ID = 8
+
+DEFAULT_TYPES = [
+    DhtMessage.TYPE,
+    IpServiceAnnouncement.TYPE,
+    ImMessage.TYPE,
+    TRUST_REQUEST,
+    ICE_CANDIDATES,
+]

@@ -1,0 +1,32 @@
+"""Sliding-window rate limiting (ref: include/opendht/rate_limiter.h:26-48).
+
+Quota per 1-second sliding window, implemented as a deque of timestamps.
+Used by the network engine both globally (1600 req/s) and per source IP
+(200 req/s, IPv6 grouped by /64 — ref: network_engine.h:462,572-599).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class RateLimiter:
+    __slots__ = ("quota", "_hist")
+
+    def __init__(self, quota: int):
+        self.quota = quota
+        self._hist: deque = deque()
+
+    def limit(self, now: float) -> bool:
+        """Record a hit at ``now``; return True if within quota."""
+        while self._hist and self._hist[0] < now - 1.0:
+            self._hist.popleft()
+        if len(self._hist) >= self.quota:
+            return False
+        self._hist.append(now)
+        return True
+
+    def maintain(self, now: float) -> int:
+        while self._hist and self._hist[0] < now - 1.0:
+            self._hist.popleft()
+        return len(self._hist)

@@ -1,0 +1,103 @@
+"""InfoHash / XOR metric unit tests.
+
+Checks the semantics documented at reference include/opendht/infohash.h
+(lowbit, commonBits, xorCmp, bit ops, SHA-1 get) plus the packed-u32
+device layout round-trip.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from opendht_tpu.utils.infohash import (HASH_BITS, HASH_LEN, InfoHash,
+                                        pack_ids, random_ids, unpack_ids)
+
+
+def test_zero_and_bool():
+    z = InfoHash()
+    assert not z
+    assert bytes(z) == bytes(20)
+    h = InfoHash.get("hello")
+    assert h
+
+
+def test_sha1_get():
+    assert bytes(InfoHash.get(b"abc")) == hashlib.sha1(b"abc").digest()
+    assert InfoHash.get("abc") == InfoHash.get(b"abc")
+
+
+def test_hex_roundtrip():
+    h = InfoHash.get_random()
+    assert InfoHash(h.hex()) == h
+    assert not InfoHash("zzzz")          # invalid hex -> zero
+    assert not InfoHash("abcd")          # short -> zero
+
+
+def test_xor_and_common_bits():
+    a = InfoHash(b"\x00" * 20)
+    b = InfoHash(b"\x80" + b"\x00" * 19)
+    assert a.common_bits(b) == 0
+    c = InfoHash(b"\x00\x01" + b"\x00" * 18)
+    assert a.common_bits(c) == 15
+    assert a.common_bits(a) == HASH_BITS
+    assert a.xor(b) == b
+
+
+def test_lowbit():
+    assert InfoHash().lowbit() == -1
+    assert InfoHash(b"\x80" + b"\x00" * 19).lowbit() == 0
+    assert InfoHash(b"\x00" * 19 + b"\x01").lowbit() == 159
+    assert InfoHash(b"\x00" * 19 + b"\x80").lowbit() == 152
+
+
+def test_bits():
+    h = InfoHash()
+    h2 = h.set_bit(0, True)
+    assert h2.get_bit(0) and not h.get_bit(0)
+    h3 = h2.set_bit(159, True)
+    assert h3.get_bit(159)
+    assert h3.set_bit(0, False) == InfoHash().set_bit(159, True)
+
+
+def test_xor_cmp():
+    t = InfoHash(b"\x00" * 20)
+    a = InfoHash(b"\x01" + b"\x00" * 19)
+    b = InfoHash(b"\x02" + b"\x00" * 19)
+    assert InfoHash.xor_cmp(a, b, t) < 0
+    assert InfoHash.xor_cmp(b, a, t) > 0
+    assert InfoHash.xor_cmp(a, a, t) == 0
+    # relative to a target near b, b is closer
+    assert InfoHash.xor_cmp(a, b, InfoHash(b"\x03" + b"\x00" * 19)) > 0
+
+
+def test_ordering():
+    a = InfoHash(b"\x01" + b"\x00" * 19)
+    b = InfoHash(b"\x02" + b"\x00" * 19)
+    assert a < b and a <= b and a != b
+    assert InfoHash.cmp(a, b) == -1 and InfoHash.cmp(b, a) == 1
+    assert InfoHash.cmp(a, a) == 0
+
+
+def test_u32_pack_roundtrip():
+    h = InfoHash.get_random()
+    assert InfoHash.from_u32(h.to_u32()) == h
+    # lexicographic limb order == byte order
+    a, b = InfoHash.get_random(), InfoHash.get_random()
+    la, lb = a.to_u32(), b.to_u32()
+    np_lt = tuple(la.tolist()) < tuple(lb.tolist())
+    assert np_lt == (a < b)
+
+
+def test_pack_ids_matrix():
+    hs = [InfoHash.get_random() for _ in range(7)]
+    mat = pack_ids(hs)
+    assert mat.shape == (7, 5) and mat.dtype == np.uint32
+    assert unpack_ids(mat) == hs
+
+
+def test_random_ids_shape():
+    rng = np.random.default_rng(0)
+    mat = random_ids(100, rng)
+    assert mat.shape == (100, 5)
+    assert len({tuple(r) for r in mat.tolist()}) == 100

@@ -1,0 +1,141 @@
+"""Routing table / node cache tests (ref: src/routing_table.cpp, node_cache.cpp)."""
+
+import random
+
+from opendht_tpu.core.constants import TARGET_NODES
+from opendht_tpu.core.node import Node
+from opendht_tpu.core.node_cache import NodeCache
+from opendht_tpu.core.routing_table import RoutingTable
+from opendht_tpu.utils.infohash import InfoHash
+from opendht_tpu.utils.sockaddr import AF_INET, SockAddr
+
+
+def mknode(i: int, cache=None) -> Node:
+    rng = random.Random(i)
+    nid = InfoHash(bytes(rng.getrandbits(8) for _ in range(20)))
+    addr = SockAddr(f"10.0.{(i >> 8) & 255}.{i & 255}", 4222)
+    if cache:
+        return cache.get_node(nid, addr)
+    return Node(nid, addr)
+
+
+def test_find_bucket_single():
+    rt = RoutingTable(AF_INET)
+    assert rt.find_bucket_index(InfoHash.get_random()) == 0
+    assert rt.is_empty()
+
+
+def test_split_redistributes():
+    rt = RoutingTable(AF_INET)
+    b = rt.buckets[0]
+    nodes = [mknode(i) for i in range(16)]
+    b.nodes = list(nodes)
+    assert rt.split(0)
+    assert len(rt.buckets) == 2
+    # bucket 1 holds ids with bit 0 set
+    for n in rt.buckets[0].nodes:
+        assert not n.id.get_bit(0)
+    for n in rt.buckets[1].nodes:
+        assert n.id.get_bit(0)
+    assert rt.node_count() == 16
+    # find_bucket routes each node home
+    for n in nodes:
+        assert rt.find_bucket(n.id).contains(n.id)
+
+
+def test_find_closest_nodes_sorted():
+    rt = RoutingTable(AF_INET)
+    now = 0.0
+    nodes = [mknode(i) for i in range(64)]
+    for n in nodes:
+        n.time = now
+        n.reply_time = now   # make them good
+        rt.find_bucket(n.id).nodes.append(n)
+        idx = rt.find_bucket_index(n.id)
+        while len(rt.buckets[idx].nodes) > TARGET_NODES and rt.split(idx):
+            idx = rt.find_bucket_index(n.id)
+    target = InfoHash.get("target")
+    out = rt.find_closest_nodes(target, now, 8)
+    assert len(out) == 8
+    # verify XOR-sortedness
+    for a, b in zip(out, out[1:]):
+        assert InfoHash.xor_cmp(a.id, b.id, target) <= 0
+    # verify these really are the 8 closest of all inserted
+    best = sorted(nodes, key=lambda n: bytes(n.id.xor(target)))[:8]
+    assert {bytes(n.id) for n in out} == {bytes(n.id) for n in best}
+
+
+def test_closest_skips_bad_nodes():
+    rt = RoutingTable(AF_INET)
+    now = 1e6
+    good, bad = mknode(1), mknode(2)
+    good.time = good.reply_time = now
+    # bad never replied
+    rt.buckets[0].nodes = [good, bad]
+    out = rt.find_closest_nodes(InfoHash.get("x"), now, 8)
+    assert out == [good]
+
+
+def test_random_id_in_bucket_range():
+    rt = RoutingTable(AF_INET)
+    for n in (mknode(i) for i in range(64)):
+        n.time = n.reply_time = 0.0
+        rt.find_bucket(n.id).nodes.append(n)
+        idx = rt.find_bucket_index(n.id)
+        while len(rt.buckets[idx].nodes) > TARGET_NODES and rt.split(idx):
+            idx = rt.find_bucket_index(n.id)
+    assert len(rt.buckets) > 2
+    rng = random.Random(7)
+    for idx in range(len(rt.buckets)):
+        for _ in range(5):
+            rid = rt.random_id(idx, rng)
+            assert rt.find_bucket_index(rid) == idx
+
+
+def test_node_cache_identity():
+    c = NodeCache()
+    a1 = mknode(5, c)
+    a2 = c.get_node(a1.id, a1.addr)
+    assert a1 is a2
+    assert c.find(a1.id, AF_INET) is a1
+
+
+def test_node_cache_closest_walk():
+    c = NodeCache()
+    keep = [mknode(i, c) for i in range(50)]   # keep refs alive
+    target = InfoHash.get("t")
+    out = c.get_cached_nodes(target, AF_INET, 10)
+    assert len(out) == 10
+    best = sorted(keep, key=lambda n: bytes(n.id.xor(target)))[:10]
+    # closest walk over sorted ids is an approximation of true XOR order;
+    # the true closest node must be found, and all results near the key
+    assert bytes(out[0].id) in {bytes(n.id) for n in best}
+
+
+def test_node_cache_weak():
+    import gc
+    c = NodeCache()
+    n = mknode(3, c)
+    nid = n.id
+    del n
+    gc.collect()
+    assert c.find(nid, AF_INET) is None
+
+
+def test_node_liveness():
+    n = mknode(1)
+    assert not n.is_good(0.0)
+    n.received(100.0, None)
+    assert not n.is_good(100.0)   # heard but never replied
+    class R:  # minimal request stub
+        tid = 1
+        def pending(self):
+            return False
+    n.requested(R())
+    n.received(100.0, R())
+    assert n.is_good(100.0)
+    assert not n.is_good(100.0 + 11 * 60)  # not heard for >10 min
+    n.set_expired()
+    assert n.is_expired() and not n.is_good(100.0)
+    n.reset_expired()
+    assert not n.is_expired()

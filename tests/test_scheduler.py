@@ -1,0 +1,73 @@
+"""Scheduler + clock unit tests (ref semantics: include/opendht/scheduler.h)."""
+
+from opendht_tpu.core.scheduler import Scheduler
+from opendht_tpu.utils.clock import TIME_MAX, VirtualClock
+
+
+def test_run_due_jobs_in_order():
+    clk = VirtualClock()
+    s = Scheduler(clk)
+    order = []
+    s.add(2.0, lambda: order.append("b"))
+    s.add(1.0, lambda: order.append("a"))
+    s.add(5.0, lambda: order.append("c"))
+    clk.advance(3.0)
+    nxt = s.run()
+    assert order == ["a", "b"]
+    assert nxt == 5.0
+    clk.advance(2.0)
+    s.run()
+    assert order == ["a", "b", "c"]
+    assert s.run() == TIME_MAX
+
+
+def test_cancel():
+    clk = VirtualClock()
+    s = Scheduler(clk)
+    hits = []
+    j = s.add(1.0, lambda: hits.append(1))
+    j.cancel()
+    clk.advance(2.0)
+    s.run()
+    assert hits == []
+
+
+def test_edit_moves_job():
+    clk = VirtualClock()
+    s = Scheduler(clk)
+    hits = []
+    j = s.add(1.0, lambda: hits.append(clk.now()))
+    j2 = s.edit(j, 4.0)
+    clk.advance(2.0)
+    s.run()
+    assert hits == []          # moved past 2.0
+    clk.advance(2.0)
+    s.run()
+    assert hits == [4.0]
+    assert not j.active and not j2.active
+
+
+def test_same_time_fifo():
+    clk = VirtualClock()
+    s = Scheduler(clk)
+    order = []
+    s.add(1.0, lambda: order.append(1))
+    s.add(1.0, lambda: order.append(2))
+    clk.advance(1.0)
+    s.run()
+    assert order == [1, 2]
+
+
+def test_job_added_during_run():
+    clk = VirtualClock()
+    s = Scheduler(clk)
+    order = []
+
+    def first():
+        order.append("first")
+        s.add(s.time(), lambda: order.append("nested"))
+
+    s.add(1.0, first)
+    clk.advance(1.0)
+    s.run()
+    assert order == ["first", "nested"]

@@ -1,0 +1,91 @@
+"""Value wire form + query algebra tests (ref: include/opendht/value.h)."""
+
+import msgpack
+import pytest
+
+from opendht_tpu.core.value import (Field, FieldValue, Query, Select, Value,
+                                    Where, f_chain_and, f_id, f_value_type)
+
+
+def test_plain_roundtrip():
+    v = Value(b"hello world", value_id=42, user_type="text/plain")
+    blob = v.packed()
+    v2 = Value.from_packed(blob)
+    assert v2.id == 42
+    assert v2.data == b"hello world"
+    assert v2.user_type == "text/plain"
+    assert not v2.is_signed() and not v2.is_encrypted()
+    assert v == v2
+
+
+def test_wire_shape_matches_reference_layout():
+    # map {id, dat}; dat is a map {body{type,data}} for unsigned values
+    v = Value(b"x", value_id=7)
+    o = msgpack.unpackb(v.packed(), raw=False)
+    assert set(o.keys()) == {"id", "dat"}
+    assert o["id"] == 7
+    assert set(o["dat"].keys()) == {"body"}
+    assert o["dat"]["body"]["type"] == 0
+    assert o["dat"]["body"]["data"] == b"x"
+
+
+def test_encrypted_value_body_is_bin():
+    v = Value()
+    v.id = 1
+    v.cypher = b"\x01\x02\x03"
+    o = msgpack.unpackb(v.packed(), raw=False)
+    assert o["dat"] == b"\x01\x02\x03"
+    v2 = Value.from_packed(v.packed())
+    assert v2.is_encrypted() and v2.cypher == b"\x01\x02\x03"
+
+
+def test_filters():
+    v = Value(b"d", type_id=3, value_id=9)
+    assert f_id(9)(v) and not f_id(8)(v)
+    assert f_value_type(3)(v)
+    both = f_chain_and(f_id(9), f_value_type(3))
+    assert both(v)
+    assert not f_chain_and(f_id(9), f_value_type(4))(v)
+
+
+def test_query_parse():
+    q = Query(q="SELECT id WHERE value_type=3 seq=2")
+    assert q.select.fields == [Field.Id]
+    assert FieldValue(Field.ValueType, 3) in q.where.filters
+    assert FieldValue(Field.SeqNum, 2) in q.where.filters
+
+
+def test_query_satisfaction():
+    # reference semantics (src/value.cpp:411-425)
+    q_all = Query()
+    q_sel = Query(Select([Field.Id]))
+    assert q_all.is_satisfied_by(q_all)
+    # q_sel's reply has only ids: cannot satisfy q_all (wants full values)
+    assert not q_all.is_satisfied_by(q_sel)
+    # q_sel is satisfied by q_sel (same projection)
+    assert q_sel.is_satisfied_by(q_sel)
+    # a where-constrained query is satisfied by an unconstrained one
+    q_w1 = Query(where=Where().id(5))
+    assert q_w1.is_satisfied_by(q_w1)
+    assert q_w1.is_satisfied_by(q_all)
+    # but an unconstrained query is NOT satisfied by a filtered reply
+    assert not q_all.is_satisfied_by(q_w1)
+
+
+def test_query_pack_roundtrip():
+    q = Query(Select([Field.Id, Field.SeqNum]), Where().value_type(2).id(4))
+    blob = msgpack.packb(q.pack())
+    q2 = Query.unpack(msgpack.unpackb(blob, raw=False))
+    assert q2 == q
+
+
+def test_where_filter_apply():
+    v = Value(b"d", type_id=2, value_id=4)
+    assert Where().value_type(2).id(4).get_filter()(v)
+    assert not Where().value_type(1).get_filter()(v)
+
+
+def test_value_ids_random():
+    ids = {Value.random_id() for _ in range(100)}
+    assert len(ids) == 100
+    assert 0 not in ids
