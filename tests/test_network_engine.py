@@ -1,0 +1,251 @@
+"""Network engine tests over the deterministic virtual transport.
+
+This is the unit-testing the reference never could do (SURVEY §4): the
+engine + wire protocol exercised without sockets, with virtual time.
+"""
+
+import msgpack
+import pytest
+
+from opendht_tpu.core.constants import MAX_ATTEMPT_COUNT, MAX_RESPONSE_TIME
+from opendht_tpu.core.node_cache import NodeCache
+from opendht_tpu.core.scheduler import Scheduler
+from opendht_tpu.core.value import Value
+from opendht_tpu.net.network_engine import (DhtProtocolException,
+                                            NetworkEngine, RequestAnswer)
+from opendht_tpu.net.transport import VirtualNetwork
+from opendht_tpu.net.wire import parse_message
+from opendht_tpu.utils.clock import VirtualClock
+from opendht_tpu.utils.infohash import InfoHash
+from opendht_tpu.utils.sockaddr import SockAddr
+
+
+class StubHandler:
+    """Minimal DHT-core handler: records calls, returns canned answers."""
+
+    def __init__(self, myid):
+        self.myid = myid
+        self.calls = []
+        self.answer = RequestAnswer()
+        self.errors = []
+
+    def on_error(self, req, code):
+        self.errors.append(code)
+
+    def on_new_node(self, node, confirm):
+        self.calls.append(("new_node", node.id, confirm))
+
+    def on_reported_addr(self, nid, addr):
+        self.calls.append(("reported_addr", addr))
+
+    def on_ping(self, node):
+        self.calls.append(("ping", node.id))
+        return RequestAnswer()
+
+    def on_find(self, node, target, want):
+        self.calls.append(("find", target))
+        return self.answer
+
+    def on_get_values(self, node, h, want, query):
+        self.calls.append(("get", h))
+        return self.answer
+
+    def on_listen(self, node, h, token, sid, query):
+        self.calls.append(("listen", h, token, sid))
+        return RequestAnswer()
+
+    def on_announce(self, node, h, values, created, token):
+        self.calls.append(("announce", h, values, token))
+        ans = RequestAnswer()
+        ans.vid = values[0].id if values else 0
+        return ans
+
+    def on_refresh(self, node, h, vid, token):
+        self.calls.append(("refresh", h, vid))
+        return RequestAnswer()
+
+
+def make_pair(loss=0.0):
+    clk = VirtualClock()
+    sch = Scheduler(clk)
+    net = VirtualNetwork(sch, delay=0.005, loss=loss, seed=1)
+    engines = []
+    for i, host in enumerate(("10.0.0.1", "10.0.0.2")):
+        myid = InfoHash.get(f"node{i}")
+        sock = net.socket(host, 4222)
+        h = StubHandler(myid)
+        eng = NetworkEngine(myid, 0, sock, None, sch, h, NodeCache())
+        engines.append((eng, h))
+    return clk, sch, net, engines
+
+
+def run(clk, sch, dt=1.0, step=0.001):
+    end = clk.now() + dt
+    while clk.now() < end:
+        nxt = sch.run()
+        if nxt > end:
+            clk.set(end)
+            break
+        clk.set(max(nxt, clk.now() + step))
+    sch.run()
+
+
+def test_ping_pong():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    done = []
+    e1.send_ping(peer, on_done=lambda r, a: done.append(r))
+    run(clk, sch, 0.1)
+    assert done and done[0].completed()
+    assert ("ping", e1.myid) in h2.calls
+    assert peer.is_good(clk.now())
+
+
+def test_request_expiry_after_3_attempts():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    # peer that doesn't exist on the network
+    ghost = e1.cache.get_node(InfoHash.get("ghost"), SockAddr("10.0.9.9", 1))
+    expired = []
+    req = e1.send_ping(ghost, on_expired=lambda r, over: expired.append(over))
+    run(clk, sch, MAX_ATTEMPT_COUNT * MAX_RESPONSE_TIME + 1.0)
+    assert expired == [True]
+    assert req.expired()
+    assert req.attempt_count == MAX_ATTEMPT_COUNT
+
+
+def test_find_node_returns_nodes():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    # e2 will answer with one known node
+    n3 = e2.cache.get_node(InfoHash.get("third"), SockAddr("10.0.0.3", 4222))
+    h2.answer.nodes4 = [n3]
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    got = []
+    e1.send_find_node(peer, InfoHash.get("target"), 1,
+                      on_done=lambda r, a: got.append(a))
+    run(clk, sch, 0.1)
+    assert got
+    assert [n.id for n in got[0].nodes4] == [InfoHash.get("third")]
+    # discovered node entered e1's cache via on_new_node(confirm=0)
+    assert any(c == ("new_node", InfoHash.get("third"), 0) for c in h1.calls)
+
+
+def test_get_values_roundtrip():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    h2.answer.values = [Value(b"payload", value_id=5)]
+    h2.answer.ntoken = b"tok"
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    got = []
+    e1.send_get_values(peer, InfoHash.get("key"), None, 1,
+                       on_done=lambda r, a: got.append(a))
+    run(clk, sch, 0.1)
+    assert got
+    assert got[0].ntoken == b"tok"
+    assert got[0].values[0].data == b"payload"
+
+
+def test_announce_and_refresh():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    v = Value(b"stored", value_id=77)
+    done = []
+    e1.send_announce_value(peer, InfoHash.get("k"), v, clk.now(), b"token",
+                           on_done=lambda r, a: done.append(a))
+    run(clk, sch, 0.1)
+    assert done and done[0].vid == 77
+    assert any(c[0] == "announce" and c[3] == b"token" for c in h2.calls)
+    done2 = []
+    e1.send_refresh_value(peer, InfoHash.get("k"), 77, b"token",
+                          on_done=lambda r, a: done2.append(a))
+    run(clk, sch, 0.1)
+    assert done2
+    assert any(c == ("refresh", InfoHash.get("k"), 77) for c in h2.calls)
+
+
+def test_fragmented_value_transfer():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    big = Value(bytes(range(256)) * 100, value_id=9)   # 25.6 KB > 8 KB
+    done = []
+    e1.send_announce_value(peer, InfoHash.get("k"), big, None, b"t",
+                           on_done=lambda r, a: done.append(a))
+    run(clk, sch, 0.2)
+    assert done and done[0].vid == 9
+    ann = [c for c in h2.calls if c[0] == "announce"]
+    assert ann and ann[0][2][0].data == big.data
+
+
+def test_error_reply():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+
+    def raise_unauthorized(node, h, values, created, token):
+        raise DhtProtocolException(401, "Wrong token")
+
+    h2.on_announce = raise_unauthorized
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    e1.send_announce_value(peer, InfoHash.get("k"), Value(b"x", value_id=1),
+                           None, b"bad")
+    run(clk, sch, 0.1)
+    assert h1.errors == [401]
+
+
+def test_listen_socket_push():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    pushes = []
+    req, sock = e1.send_listen(
+        peer, InfoHash.get("k"), b"tok",
+        socket_cb=lambda node, msg: pushes.append(msg))
+    run(clk, sch, 0.1)
+    listens = [c for c in h2.calls if c[0] == "listen"]
+    assert listens
+    sid = listens[0][3]
+    # e2 pushes an update to the listener through the socket id
+    lnode = e2.cache.get_node(e1.myid, SockAddr("10.0.0.1", 4222))
+    e2.tell_listener(lnode, sid, InfoHash.get("k"), [Value(b"up", value_id=3)])
+    run(clk, sch, 0.1)
+    assert pushes and pushes[0].values[0].data == b"up"
+
+
+def test_rate_limit_blocks_floods():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    # hand-craft 300 pings from the same source in <1s
+    from opendht_tpu.net.wire import MessageBuilder, make_tid
+    mb = MessageBuilder(InfoHash.get("flood"), 0)
+    src = SockAddr("10.0.0.1", 4222)
+    for i in range(300):
+        e2.process_message(mb.ping(make_tid(b"pn", i)), src)
+    pings = [c for c in h2.calls if c[0] == "ping"]
+    assert len(pings) == 200  # per-IP cap
+
+
+def test_network_id_mismatch_dropped():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    from opendht_tpu.net.wire import MessageBuilder, make_tid
+    mb = MessageBuilder(InfoHash.get("other"), 7)   # network id 7 != 0
+    e2.process_message(mb.ping(make_tid(b"pn", 1)), SockAddr("10.0.0.1", 4222))
+    assert not any(c[0] == "ping" for c in h2.calls)
+
+
+def test_blacklist():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    e1.blacklist_node(peer)
+    assert e1.is_node_blacklisted(peer.addr)
+    # messages from blacklisted addr are dropped
+    done = []
+    from opendht_tpu.net.wire import MessageBuilder, make_tid
+    mb = MessageBuilder(e2.myid, 0)
+    e1.process_message(mb.ping(make_tid(b"pn", 1)), peer.addr)
+    assert not any(c[0] == "ping" for c in h1.calls)
+
+
+def test_stats_counters():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    e1.send_ping(peer)
+    run(clk, sch, 0.1)
+    i1, o1 = e1.get_stats()
+    i2, o2 = e2.get_stats()
+    assert o1.get("ping") == 1
+    assert i2.get("ping") == 1
+    assert i1.get("reply") == 1
