@@ -427,6 +427,51 @@ class Where:
         return isinstance(other, Where) and self.filters == other.filters
 
 
+class FieldValueIndex:
+    """A partial value: projection of selected fields
+    (ref: value.h:883-900, src/value.cpp FieldValueIndex)."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, value: Optional[Value] = None,
+                 select: Optional["Select"] = None):
+        self.index: Dict[Field, object] = {}
+        if value is None:
+            return
+        fields = (select.fields if select and select.fields
+                  else [Field.Id, Field.ValueType, Field.OwnerPk,
+                        Field.SeqNum, Field.UserType])
+        for f in fields:
+            if f == Field.Id:
+                self.index[f] = value.id
+            elif f == Field.ValueType:
+                self.index[f] = value.type
+            elif f == Field.OwnerPk:
+                self.index[f] = (value.owner.get_id() if value.owner else None)
+            elif f == Field.SeqNum:
+                self.index[f] = value.seq
+            elif f == Field.UserType:
+                self.index[f] = value.user_type
+
+    @classmethod
+    def from_fields(cls, fields: Sequence[Field], row: Sequence
+                    ) -> "FieldValueIndex":
+        fvi = cls()
+        for f, v in zip(fields, row):
+            fvi.index[Field(f)] = v
+        return fvi
+
+    def contained_in(self, other: "FieldValueIndex") -> bool:
+        """True if every (field, value) here also appears in ``other``."""
+        return all(other.index.get(f) == v for f, v in self.index.items())
+
+    def __eq__(self, other):
+        return isinstance(other, FieldValueIndex) and self.index == other.index
+
+    def __repr__(self):
+        return f"FieldValueIndex({self.index})"
+
+
 class Query:
     """SELECT <fields> WHERE <constraints> (ref: value.h:819-880)."""
 

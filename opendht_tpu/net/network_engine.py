@@ -78,17 +78,17 @@ class RequestAnswer:
     """Reply payload produced by DHT-core handlers
     (ref: NetworkEngine::RequestAnswer network_engine.h:220-240)."""
 
-    __slots__ = ("ntoken", "vid", "values", "fields", "field_values",
-                 "nodes4", "nodes6")
+    __slots__ = ("ntoken", "vid", "values", "fields", "nodes4", "nodes6",
+                 "expired")
 
     def __init__(self):
         self.ntoken = b""
         self.vid = 0
         self.values: List[Value] = []
-        self.fields: List[int] = []
-        self.field_values: List[list] = []
+        self.fields: List["FieldValueIndex"] = []  # partial values
         self.nodes4: List[Node] = []
         self.nodes6: List[Node] = []
+        self.expired = False  # listen push marked values as expired
 
 
 class Socket:
@@ -204,10 +204,13 @@ class NetworkEngine:
             return
         now = self.scheduler.time()
         if req.over_attempts():
+            # 3 unanswered attempts: request and node expire
+            # (ref: requestStep :243-247)
             req.state = RequestState.EXPIRED
             self.requests.pop(req.tid, None)
             if req.node is not None:
                 req.node.request_expired(req)
+                req.node.set_expired()
             if req.on_expired:
                 req.on_expired(req, True)
             return
@@ -563,12 +566,13 @@ class NetworkEngine:
             self.handler.on_new_node(n, 0)
 
     def _answer_from(self, msg: ParsedMessage) -> RequestAnswer:
+        from ..core.value import Field, FieldValueIndex
         ans = RequestAnswer()
         ans.ntoken = msg.token
         ans.vid = msg.value_id
         ans.values = msg.values
-        ans.fields = msg.fields
-        ans.field_values = msg.field_values
+        ans.fields = [FieldValueIndex.from_fields(
+            [Field(f) for f in msg.fields], row) for row in msg.field_values]
         ans.nodes4 = [self.cache.get_node(nid, a) for nid, a in msg.nodes4
                       if nid != self.myid]
         ans.nodes6 = [self.cache.get_node(nid, a) for nid, a in msg.nodes6

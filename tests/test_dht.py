@@ -1,0 +1,210 @@
+"""End-to-end DHT core tests over the deterministic in-process swarm.
+
+Scenario parity with the reference harness (SURVEY §4): put→get round-trip,
+listen/pub-sub, value expiry, token auth, routing convergence, persistence
+after node death (re-found on living nodes).
+"""
+
+import pytest
+
+from opendht_tpu.core.value import Value, Where
+from opendht_tpu.utils.infohash import InfoHash
+
+from dht_harness import SimCluster
+
+
+def test_put_get_roundtrip_small_net():
+    c = SimCluster(8)
+    c.bootstrap_all()
+    c.run(2.0)
+
+    key = InfoHash.get("the-key")
+    put_done = []
+    c.nodes[1].put(key, Value(b"hello dht", value_id=1),
+                   lambda ok, nodes: put_done.append(ok))
+    assert c.run_until(lambda: put_done, 30.0)
+    assert put_done[0] is True
+
+    got, done = [], []
+    c.nodes[5].get(key, lambda vals: (got.extend(vals), True)[1],
+                   lambda ok, nodes: done.append(ok))
+    assert c.run_until(lambda: done, 30.0)
+    assert any(v.data == b"hello dht" for v in got)
+
+
+def test_get_missing_key_completes_false():
+    c = SimCluster(6)
+    c.bootstrap_all()
+    c.run(2.0)
+    got, done = [], []
+    c.nodes[2].get(InfoHash.get("nothing-here"),
+                   lambda vals: True,
+                   lambda ok, nodes: done.append(ok))
+    assert c.run_until(lambda: done, 30.0)
+    assert got == []
+
+
+def test_local_value_returned_immediately():
+    c = SimCluster(3)
+    c.bootstrap_all()
+    c.run(1.0)
+    key = InfoHash.get("local")
+    c.nodes[0].put(key, Value(b"mine", value_id=7))
+    c.run(5.0)
+    got, done = [], []
+    c.nodes[0].get(key, lambda vals: (got.extend(vals), True)[1],
+                   lambda ok, nodes: done.append(ok))
+    c.run_until(lambda: done, 20.0)
+    assert any(v.data == b"mine" for v in got)
+
+
+def test_listen_receives_later_put():
+    c = SimCluster(8)
+    c.bootstrap_all()
+    c.run(2.0)
+    key = InfoHash.get("channel")
+
+    heard = []
+    token = c.nodes[3].listen(key, lambda vals: (heard.extend(vals), True)[1])
+    assert token
+    c.run(3.0)
+
+    c.nodes[6].put(key, Value(b"published", value_id=42))
+    assert c.run_until(lambda: heard, 60.0)
+    assert any(v.data == b"published" for v in heard)
+
+    # cancel: later puts are not delivered
+    c.nodes[3].cancel_listen(key, token)
+    heard.clear()
+    c.nodes[6].put(key, Value(b"after-cancel", value_id=43))
+    c.run(10.0)
+    assert not any(v.data == b"after-cancel" for v in heard)
+
+
+def test_value_filter_where():
+    c = SimCluster(6)
+    c.bootstrap_all()
+    c.run(2.0)
+    key = InfoHash.get("filtered")
+    c.nodes[0].put(key, Value(b"a", type_id=0, value_id=1))
+    c.nodes[0].put(key, Value(b"b", type_id=3, value_id=2))
+    c.run(10.0)
+    got, done = [], []
+    c.nodes[4].get(key, lambda vals: (got.extend(vals), True)[1],
+                   lambda ok, nodes: done.append(ok),
+                   where=Where().value_type(3))
+    assert c.run_until(lambda: done, 30.0)
+    assert got and all(v.type == 3 for v in got)
+
+
+def test_routing_convergence():
+    c = SimCluster(16)
+    c.bootstrap_all()
+    c.run(120.0)
+    # after 2 virtual minutes of maintenance, every node should know
+    # a healthy set of peers
+    for d in c.nodes:
+        good, dubious, cached, _ = d.get_nodes_stats(4)
+        assert good + dubious >= 4, f"{d.myid}: {good}+{dubious}"
+
+
+def test_persistence_after_node_death():
+    c = SimCluster(12)
+    c.bootstrap_all()
+    c.run(60.0)   # let routing tables converge before killing nodes
+    key = InfoHash.get("survivor")
+    done = []
+    c.nodes[1].put(key, Value(b"precious", value_id=9),
+                   lambda ok, nodes: done.append(ok), permanent=True)
+    assert c.run_until(lambda: done, 30.0)
+
+    # find which nodes hold the value, kill up to 2 of them (not the origin)
+    holders = [d for d in c.nodes if d.get_local(key)]
+    assert holders
+    killed = 0
+    for d in holders:
+        if d is not c.nodes[1] and killed < 2:
+            c.kill(d)
+            killed += 1
+
+    # the origin re-announces permanent values; a get from a live node
+    # must still find it
+    got, gdone = [], []
+    c.nodes[8].get(key, lambda vals: (got.extend(vals), True)[1],
+                   lambda ok, nodes: gdone.append(ok))
+    assert c.run_until(lambda: gdone, 60.0)
+    assert any(v.data == b"precious" for v in got)
+
+
+def test_value_expiry():
+    c = SimCluster(4)
+    c.bootstrap_all()
+    c.run(2.0)
+    key = InfoHash.get("ephemeral")
+    c.nodes[0].put(key, Value(b"gone soon", value_id=5))   # USER_DATA: 10 min
+    c.run(5.0)
+    assert any(d.get_local(key) for d in c.nodes)
+    c.run(16 * 60)   # TTL + expire-job jitter
+    assert not any(d.get_local(key) for d in c.nodes)
+
+
+def test_token_auth_direct():
+    """Announces with a bad token are rejected with 401."""
+    c = SimCluster(2)
+    c.interconnect()
+    c.run(1.0)
+    a, b = c.nodes
+    node_b = a.cache.get_node(b.myid, c.addr_of(b))
+    errors = []
+    orig = a.on_error
+    a.on_error = lambda req, code: (errors.append(code), orig(req, code))
+    a.engine.send_announce_value(node_b, InfoHash.get("k"),
+                                 Value(b"x", value_id=1), None, b"badtoken")
+    c.run(2.0)
+    assert 401 in errors
+    assert not b.get_local(InfoHash.get("k"))
+
+
+def test_stats_and_public_address():
+    c = SimCluster(6)
+    c.bootstrap_all()
+    c.run(60.0)
+    d = c.nodes[2]
+    good, dubious, cached, incoming = d.get_nodes_stats(4)
+    assert good >= 1
+    # peers echo our observed address in replies
+    addrs = d.get_public_address()
+    assert addrs and addrs[0].host == c.addr_of(d).host
+
+
+def test_export_import_values():
+    c = SimCluster(3)
+    c.bootstrap_all()
+    c.run(1.0)
+    key = InfoHash.get("exported")
+    c.nodes[0]._storage_store(key, Value(b"keep", value_id=3),
+                              c.clock.now())
+    data = c.nodes[0].export_values()
+    assert data
+    c.nodes[2].import_values(data)
+    vals = c.nodes[2].get_local(key)
+    assert vals and vals[0].data == b"keep"
+
+
+def test_export_nodes_roundtrip():
+    c = SimCluster(8)
+    c.bootstrap_all()
+    c.run(60.0)
+    exported = c.nodes[1].export_nodes()
+    assert exported
+    fresh = c.add_node()
+    for nid, addr in exported:
+        fresh.insert_node(nid, addr)
+    got, done = [], []
+    key = InfoHash.get("after-import")
+    c.nodes[0].put(key, Value(b"x", value_id=2))
+    c.run(5.0)
+    fresh.get(key, lambda vals: (got.extend(vals), True)[1],
+              lambda ok, nodes: done.append(ok))
+    assert c.run_until(lambda: done, 30.0)
+    assert got
