@@ -1,0 +1,12 @@
+"""Device-side kernels: batched 160-bit XOR metric, top-k, Pallas hot ops."""
+
+from .xor_metric import (  # noqa: F401
+    common_bits,
+    closest_nodes,
+    closest_nodes_batched,
+    merge_shortlists,
+    sort_by_distance,
+    xor_ids,
+    xor_less,
+)
+from .pallas_kernels import nearest_ids  # noqa: F401

@@ -2,14 +2,23 @@
 
 Real TPU hardware (one chip) is reserved for bench.py; tests exercise the
 multi-device sharding paths on virtual CPU devices, per the driver's
-dry-run model.  Must run before jax is imported anywhere.
+dry-run model.
+
+The environment's sitecustomize imports jax at interpreter start with
+``JAX_PLATFORMS=axon``, so setting env vars here is too late for jax's
+import-time config read — but the backend itself is initialised lazily,
+so ``jax.config.update`` still wins as long as it runs before the first
+``jax.devices()`` call.  ``XLA_FLAGS`` is read at backend init, so the
+host-platform device count env var is still effective from here.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,130 @@
+"""Device XOR-metric ops vs the host InfoHash reference semantics."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from opendht_tpu.utils.infohash import InfoHash, pack_ids, random_ids
+from opendht_tpu.ops import (
+    common_bits, closest_nodes, closest_nodes_batched, merge_shortlists,
+    nearest_ids, sort_by_distance, xor_less,
+)
+
+
+def brute_closest(ids_np: np.ndarray, target: InfoHash, k: int):
+    """Ground truth via host big-int XOR sort."""
+    t = int.from_bytes(bytes(target), "big")
+    dists = []
+    for i in range(ids_np.shape[0]):
+        b = b"".join(int(x).to_bytes(4, "big") for x in ids_np[i])
+        dists.append((int.from_bytes(b, "big") ^ t, i))
+    dists.sort()
+    return [i for _, i in dists[:k]]
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(42)
+
+
+def test_common_bits_matches_host(rng):
+    ids = random_ids(64, rng)
+    a, b = jnp.asarray(ids[:32]), jnp.asarray(ids[32:])
+    dev = np.asarray(common_bits(a, b))
+    hosts = [InfoHash.from_u32(ids[i]).common_bits(InfoHash.from_u32(ids[32 + i]))
+             for i in range(32)]
+    assert dev.tolist() == hosts
+    assert int(common_bits(a[0], a[0])) == 160
+
+
+def test_xor_less_matches_host(rng):
+    ids = random_ids(96, rng)
+    t = InfoHash.get_random(rng)
+    ti = int.from_bytes(bytes(t), "big")
+    d = np.bitwise_xor(ids, np.asarray(t.to_u32()))
+    da, db = jnp.asarray(d[:48]), jnp.asarray(d[48:])
+    dev = np.asarray(xor_less(da, db))
+    for i in range(48):
+        ha = int.from_bytes(
+            b"".join(int(x).to_bytes(4, "big") for x in d[i]), "big")
+        hb = int.from_bytes(
+            b"".join(int(x).to_bytes(4, "big") for x in d[48 + i]), "big")
+        assert bool(dev[i]) == (ha < hb)
+
+
+def test_closest_nodes_exact(rng):
+    ids = random_ids(500, rng)
+    t = InfoHash.get_random(rng)
+    got = np.asarray(closest_nodes(jnp.asarray(ids), jnp.asarray(t.to_u32()), 8))
+    assert got.tolist() == brute_closest(ids, t, 8)
+
+
+def test_closest_nodes_batched(rng):
+    ids = random_ids(1000, rng)
+    targets = random_ids(16, rng)
+    got = np.asarray(closest_nodes_batched(
+        jnp.asarray(ids), jnp.asarray(targets), 8))
+    for li in range(16):
+        want = brute_closest(ids, InfoHash.from_u32(targets[li]), 8)
+        assert got[li].tolist() == want
+
+
+def test_sort_by_distance_with_payload(rng):
+    ids = random_ids(40, rng)
+    t = random_ids(1, rng)[0]
+    payload = jnp.arange(40, dtype=jnp.int32)
+    s_ids, s_pay = sort_by_distance(jnp.asarray(ids), jnp.asarray(t), payload)
+    order = brute_closest(ids, InfoHash.from_u32(t), 40)
+    assert np.asarray(s_pay).tolist() == order
+    assert np.array_equal(np.asarray(s_ids), ids[order])
+
+
+def test_merge_shortlists_dedup_and_queried(rng):
+    ids = random_ids(20, rng)
+    t = random_ids(2, rng)
+    # Candidates: nodes 0..9 (queried even ones) + dup of 3,4 unqueried +
+    # two empty slots.
+    cand_idx = np.array([[0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 3, 4, -1, -1]] * 2,
+                        np.int32)
+    cand_ids = ids[np.clip(cand_idx, 0, 19)]
+    queried = np.zeros_like(cand_idx, bool)
+    queried[:, 0:10:2] = True
+    f_idx, f_ids, f_q = merge_shortlists(
+        jnp.asarray(t), jnp.asarray(cand_ids), jnp.asarray(cand_idx),
+        jnp.asarray(queried), keep=8)
+    f_idx, f_q = np.asarray(f_idx), np.asarray(f_q)
+    for li in range(2):
+        want = brute_closest(ids[:10], InfoHash.from_u32(t[li]), 8)
+        assert f_idx[li].tolist() == want
+        for j, node in enumerate(f_idx[li]):
+            assert f_q[li, j] == (node % 2 == 0)  # queried survives dedup
+
+
+def test_merge_shortlists_pads_with_minus_one(rng):
+    ids = random_ids(3, rng)
+    t = random_ids(1, rng)
+    cand_idx = np.array([[0, 1, 2, -1, -1, -1]], np.int32)
+    cand_ids = ids[np.clip(cand_idx, 0, 2)]
+    f_idx, _, f_q = merge_shortlists(
+        jnp.asarray(t), jnp.asarray(cand_ids), jnp.asarray(cand_idx),
+        jnp.zeros((1, 6), bool), keep=5)
+    assert np.asarray(f_idx)[0, 3:].tolist() == [-1, -1]
+    assert not np.asarray(f_q)[0, 3:].any()
+
+
+def test_pallas_nearest_matches_brute(rng):
+    ids = random_ids(700, rng)  # not a multiple of tile_n: exercises padding
+    targets = random_ids(9, rng)
+    got = np.asarray(nearest_ids(jnp.asarray(ids), jnp.asarray(targets),
+                                 tile_l=8, tile_n=256))
+    for li in range(9):
+        want = brute_closest(ids, InfoHash.from_u32(targets[li]), 1)[0]
+        assert got[li] == want
+
+
+def test_pallas_nearest_includes_self(rng):
+    ids = random_ids(300, rng)
+    got = np.asarray(nearest_ids(jnp.asarray(ids), jnp.asarray(ids[:5]),
+                                 tile_l=8, tile_n=128))
+    assert got.tolist() == [0, 1, 2, 3, 4]
