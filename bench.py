@@ -1,0 +1,93 @@
+"""Swarm-engine benchmark: batched Kademlia lookups on real hardware.
+
+Prints ONE JSON line:
+  {"metric": "swarm_lookups_per_sec", "value": ..., "unit": "lookups/s",
+   "vs_baseline": ...}
+
+``vs_baseline`` is measured against the reference's own operating
+point: OpenDHT resolves one iterative lookup in ~4 round-trip batches
+of α=4 RPCs with a 1 s response timeout (request.h:113, dht.h:327) and
+caps inbound traffic at 1600 req/s per node
+(network_engine.h:462) — on its Python ``benchmark.py --performance -t
+gets`` netns harness a get takes O(100 ms) and a 32-node swarm
+sustains O(10^2..10^3) lookups/sec (BASELINE.md: no published numbers;
+self-measured scale).  We use 1000 lookups/sec as the generous
+reference-swarm figure, so vs_baseline = value / 1000.
+
+Extra context fields (hop count, recall, swarm size) ride along in the
+same JSON object.
+"""
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_LOOKUPS_PER_SEC = 1000.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1_000_000)
+    ap.add_argument("--lookups", type=int, default=100_000)
+    ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--recall-sample", type=int, default=512)
+    args = ap.parse_args()
+
+    from opendht_tpu.models.swarm import (
+        SwarmConfig, build_swarm, lookup, true_closest,
+    )
+
+    cfg = SwarmConfig.for_nodes(args.nodes)
+    key = jax.random.PRNGKey(0)
+    swarm = build_swarm(key, cfg)
+    jax.block_until_ready(swarm.tables)
+
+    targets = jax.random.bits(jax.random.PRNGKey(1), (args.lookups, 5),
+                              jnp.uint32)
+
+    # Warmup (compile).
+    res = lookup(swarm, cfg, targets, jax.random.PRNGKey(2))
+    jax.block_until_ready(res.found)
+
+    times = []
+    for r in range(args.repeat):
+        t0 = time.perf_counter()
+        res = lookup(swarm, cfg, targets, jax.random.PRNGKey(3 + r))
+        jax.block_until_ready(res.found)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    lps = args.lookups / dt
+
+    hops = np.asarray(res.hops)
+
+    # Recall on a subsample (exact k-closest over the full matrix is
+    # O(L·N); sample keeps it cheap).
+    m = min(args.recall_sample, args.lookups)
+    sample_t = targets[:m]
+    truth = np.asarray(true_closest(swarm, cfg, sample_t, k=8))
+    found = np.asarray(res.found[:m])
+    match = (truth[:, :, None] == found[:, None, :]) & (truth[:, :, None] >= 0)
+    recall = float(match.any(axis=2).mean())
+
+    out = {
+        "metric": "swarm_lookups_per_sec",
+        "value": round(lps, 1),
+        "unit": "lookups/s",
+        "vs_baseline": round(lps / REFERENCE_LOOKUPS_PER_SEC, 2),
+        "n_nodes": args.nodes,
+        "n_lookups": args.lookups,
+        "wall_s": round(dt, 4),
+        "median_hops": float(np.median(hops)),
+        "done_frac": float(np.asarray(res.done).mean()),
+        "recall_at_8": round(recall, 4),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,7 @@ from __future__ import annotations
 import msgpack
 
 from ..utils.sockaddr import SockAddr
-from .value import Value, ValueType, default_store_policy
+from .value import USER_DATA, Value, ValueType, default_store_policy
 
 
 class DhtMessage:
@@ -38,10 +38,10 @@ class DhtMessage:
         return cls(o.get("s", ""), bytes(o.get("d", b"")))
 
 
-def _ip_service_store_policy(value: Value, remote_id, from_addr) -> bool:
+def _ip_service_store_policy(key, value: Value, remote_id, from_addr) -> bool:
     """Rewrite announced address to the sender's observed address
     (ref: src/default_types.cpp:70-84)."""
-    if not default_store_policy(value, remote_id, from_addr):
+    if not default_store_policy(key, value, remote_id, from_addr):
         return False
     try:
         ann = IpServiceAnnouncement.unpack(value.data)
@@ -92,6 +92,7 @@ ICE_CANDIDATES = ValueType(5, "ICE candidates", 10 * 60)
 CERTIFICATE_TYPE_ID = 8
 
 DEFAULT_TYPES = [
+    USER_DATA,
     DhtMessage.TYPE,
     IpServiceAnnouncement.TYPE,
     ImMessage.TYPE,

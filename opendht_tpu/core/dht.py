@@ -845,7 +845,7 @@ class Dht:
                         self._storage_store(info_hash, v, created)
             else:
                 t = self.get_type(v.type)
-                if t.store_policy(v, node.id, node.addr):
+                if t.store_policy(info_hash, v, node.id, node.addr):
                     self._storage_store(info_hash, v, created)
             ans.vid = v.id
         return ans

@@ -34,14 +34,20 @@ INVALID_ID = 0
 # ValueType & policies (ref: value.h:55-106, src/value.cpp:65-69)
 # ---------------------------------------------------------------------------
 
-# StorePolicy(value, remote_id, from_addr) -> bool
-StorePolicy = Callable[["Value", bytes, object], bool]
+# StorePolicy(key, value, remote_id, from_addr) -> bool
+# (key = the InfoHash being stored at — ref value.h:55)
+StorePolicy = Callable[[object, "Value", bytes, object], bool]
 # EditPolicy(key, old_value, new_value, remote_id, from_addr) -> bool
 EditPolicy = Callable[[object, "Value", "Value", bytes, object], bool]
 
 
-def default_store_policy(value: "Value", remote_id, from_addr) -> bool:
-    """Accept any value within the size cap (ref: src/value.cpp:65-69)."""
+def default_store_policy(key, value: "Value", remote_id, from_addr) -> bool:
+    """Accept any value within the size cap (ref: src/value.cpp:65-69).
+
+    Signature mirrors the reference ``StorePolicy(InfoHash key, value,
+    remote node id, from addr)`` (value.h:55) — some policies (e.g. the
+    certificate type) depend on the storage key.
+    """
     return value.size() <= MAX_VALUE_SIZE
 
 

@@ -1,0 +1,15 @@
+"""Swarm-scale models: the TPU-resident Kademlia simulation engine."""
+
+from .swarm import (  # noqa: F401
+    LookupResult,
+    LookupState,
+    Swarm,
+    SwarmConfig,
+    build_swarm,
+    churn,
+    lookup,
+    lookup_init,
+    lookup_recall,
+    lookup_step,
+    true_closest,
+)

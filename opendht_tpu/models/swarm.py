@@ -1,0 +1,377 @@
+"""SimSwarm — the TPU-resident Kademlia swarm engine.
+
+The flagship "model" of this framework: an entire DHT swarm of N
+simulated nodes held on-device as packed tensors, with all iterative
+lookups advanced in lock-step.  This replaces the reference's one-node-
+at-a-time event loop (``Dht::searchStep`` src/dht.cpp:1343-1464 driving
+``NetworkEngine`` RPCs over UDP) with batched tensor exchanges:
+
+* **node matrix** — ``ids [N,5] uint32`` sorted lexicographically (=
+  160-bit numeric order), so every dyadic prefix range (a Kademlia
+  bucket's key-space) is a contiguous slice, found by binary search;
+* **routing tables** — ``tables [N,B,K] int32``: for node ``i`` bucket
+  ``b`` holds K members sharing *exactly* ``b`` prefix bits with ``i``
+  (the reference's ``Bucket`` of ≤8 nodes, routing_table.h:26,
+  ``TARGET_NODES``), sampled uniformly from the bucket's sorted range —
+  the steady-state of the reference's bucket maintenance
+  (src/dht.cpp:2826-2885) without simulating each ping;
+* **lookups** — a ``[L]``-batch of iterative searches in lock-step;
+  each step solicits the α=4 best unqueried shortlist nodes
+  (``MAX_REQUESTED_SEARCH_NODES`` dht.h:327), gathers their bucket
+  ``c = commonBits(node, target)`` rows (the nodes they would return
+  from ``onFindNode``, src/dht.cpp:3189-3200), and merges via the exact
+  160-bit sort (``Search::insertNode`` src/dht.cpp:961-1047); a lookup
+  is done when its 8 closest known nodes are all queried
+  (``Search::isSynced`` src/dht.cpp:1466-1479, quorum =
+  ``TARGET_NODES``);
+* **churn** — an ``alive [N]`` mask; dead solicited nodes return
+  nothing (the α-slot waste models the reference's 3×1 s timeout,
+  request.h:113) — the netem-equivalent fault injection.
+
+Everything is static-shape, ``jit``-compiled, and sharding-friendly:
+the lookup batch axis shards cleanly over a mesh (see
+``opendht_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.xor_metric import (
+    N_LIMBS,
+    closest_nodes_batched,
+    common_bits,
+    lex_searchsorted,
+    merge_shortlists,
+)
+
+UINT32_MAX = 0xFFFFFFFF
+
+
+class SwarmConfig(NamedTuple):
+    """Static swarm geometry (Python ints — part of the jit cache key).
+
+    Defaults mirror the reference's scale constants: K=8 per bucket
+    (routing_table.h:26), 14-node search sets (dht.h:314), α=4
+    (dht.h:327), sync quorum 8.
+    """
+    n_nodes: int
+    n_buckets: int
+    bucket_k: int = 8
+    search_width: int = 14
+    alpha: int = 4
+    quorum: int = 8
+    max_steps: int = 48
+
+    @classmethod
+    def for_nodes(cls, n_nodes: int, **kw) -> "SwarmConfig":
+        # Enough buckets that the deepest one holds ~2·K nodes.
+        b = max(4, int(math.ceil(math.log2(max(16, n_nodes)))) - 3)
+        return cls(n_nodes=n_nodes, n_buckets=b, **kw)
+
+
+class Swarm(NamedTuple):
+    """Device-resident swarm state (a pytree of arrays)."""
+    ids: jax.Array     # [N,5] uint32, lexicographically sorted
+    tables: jax.Array  # [N,B,K] int32 indices into ids; -1 = empty
+    alive: jax.Array   # [N] bool
+
+
+class LookupState(NamedTuple):
+    """Lock-step batched lookup state (all ``[L, ...]``)."""
+    targets: jax.Array  # [L,5]
+    idx: jax.Array      # [L,S] shortlist node indices, sorted by dist
+    ids: jax.Array      # [L,S,5]
+    queried: jax.Array  # [L,S] bool
+    done: jax.Array     # [L] bool
+    hops: jax.Array     # [L] int32 — solicitation rounds until sync
+
+
+class LookupResult(NamedTuple):
+    found: jax.Array  # [L,quorum] closest queried node indices (-1 pad)
+    hops: jax.Array   # [L]
+    done: jax.Array   # [L]
+
+
+# ---------------------------------------------------------------------------
+# bit helpers on packed ids (work with traced bit positions)
+# ---------------------------------------------------------------------------
+
+def _prefix_mask(nbits: jax.Array) -> jax.Array:
+    """``[5]`` uint32 mask keeping the first ``nbits`` bits of an id."""
+    limbs = []
+    for j in range(N_LIMBS):
+        rem = jnp.clip(nbits - 32 * j, 0, 32)
+        shift = jnp.clip(32 - rem, 0, 31).astype(jnp.uint32)
+        m = (jnp.uint32(UINT32_MAX) << shift) & jnp.uint32(UINT32_MAX)
+        limbs.append(jnp.where(rem == 0, jnp.uint32(0), m))
+    return jnp.stack(limbs, axis=-1)
+
+
+def _bit_mask(bit: jax.Array) -> jax.Array:
+    """``[5]`` uint32 with only ``bit`` (0 = MSB of limb 0) set."""
+    limbs = []
+    for j in range(N_LIMBS):
+        off = bit - 32 * j
+        in_limb = (off >= 0) & (off < 32)
+        pos = jnp.clip(31 - off, 0, 31).astype(jnp.uint32)
+        limbs.append(jnp.where(in_limb, jnp.uint32(1) << pos, jnp.uint32(0)))
+    return jnp.stack(limbs, axis=-1)
+
+
+def bucket_range(sorted_ids: jax.Array, node_ids: jax.Array,
+                 b: jax.Array, inclusive=False):
+    """Sorted-range ``[lo, hi)`` of a node's bucket-``b`` key-space.
+
+    Exclusive (normal) bucket: ids sharing *exactly* ``b`` prefix bits
+    — "first b bits equal, bit b flipped", a dyadic interval, hence
+    contiguous in the sorted matrix.  Inclusive (deepest) bucket: ids
+    sharing *at least* ``b`` bits — the reference's unsplit own-bucket
+    tail that holds a node's nearest neighbours
+    (``RoutingTable::split``/``depth``, src/routing_table.cpp:139-163).
+    """
+    pm1 = _prefix_mask(b + 1)
+    pmb = _prefix_mask(b)
+    bm = _bit_mask(b)
+    # Keep the node's first b+1 bits, then flip bit b: the bucket's
+    # key-space prefix.
+    lo_ex = (node_ids & pm1) ^ bm
+    hi_ex = lo_ex | (~pm1 & jnp.uint32(UINT32_MAX))
+    lo_in = node_ids & pmb
+    hi_in = lo_in | (~pmb & jnp.uint32(UINT32_MAX))
+    inc = jnp.asarray(inclusive)
+    lo_key = jnp.where(inc, lo_in, lo_ex)
+    hi_key = jnp.where(inc, hi_in, hi_ex)
+    lo = lex_searchsorted(sorted_ids, lo_key, side="left")
+    hi = lex_searchsorted(sorted_ids, hi_key, side="right")
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# swarm construction
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg",))
+def build_swarm(key: jax.Array, cfg: SwarmConfig) -> Swarm:
+    """Generate a random swarm with steady-state routing tables.
+
+    O(N·B·log N): per (node, bucket), one binary search for the bucket's
+    sorted range, then K stratified-uniform samples from it.
+    """
+    n, b_total, k = cfg.n_nodes, cfg.n_buckets, cfg.bucket_k
+    k_ids, k_samp = jax.random.split(key)
+    raw = jax.random.bits(k_ids, (n, N_LIMBS), jnp.uint32)
+    limbs = tuple(raw[:, i] for i in range(N_LIMBS))
+    sorted_limbs = jax.lax.sort(limbs, num_keys=N_LIMBS)
+    ids = jnp.stack(sorted_limbs, axis=-1)
+
+    u = jax.random.uniform(k_samp, (n, b_total, k))
+
+    def one_bucket(b):
+        lo, hi = bucket_range(ids, ids, b,
+                              inclusive=(b == b_total - 1))  # [N], [N]
+        size = (hi - lo).astype(jnp.float32)
+        # Stratified samples across the range: bucket membership is
+        # uniform-random in the reference's steady state too.
+        strat = (jnp.arange(k, dtype=jnp.float32)[None, :]
+                 + u[:, b, :]) / k
+        samp = lo[:, None] + jnp.floor(
+            strat * size[:, None]).astype(jnp.int32)
+        samp = jnp.clip(samp, lo[:, None], hi[:, None] - 1)
+        return jnp.where((hi > lo)[:, None], samp, -1)  # [N,K]
+
+    tables = jax.lax.map(one_bucket, jnp.arange(b_total))  # [B,N,K]
+    tables = jnp.transpose(tables, (1, 0, 2))
+    return Swarm(ids=ids, tables=tables, alive=jnp.ones((n,), bool))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def churn(swarm: Swarm, key: jax.Array, kill_frac: float,
+          cfg: SwarmConfig) -> Swarm:
+    """Kill a uniform fraction of nodes (netem-equivalent fault mask).
+
+    Dead nodes stop answering; routing-table entries pointing at them
+    become wasted α-slots, exactly like the reference's expired nodes
+    awaiting eviction (src/node.cpp:34-40).
+    """
+    keep = jax.random.uniform(key, (cfg.n_nodes,)) >= kill_frac
+    return swarm._replace(alive=swarm.alive & keep)
+
+
+# ---------------------------------------------------------------------------
+# lookups
+# ---------------------------------------------------------------------------
+
+def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
+             nid: jax.Array):
+    """What each solicited node returns for each target.
+
+    ``targets``: ``[L,5]``; ``nid``: ``[L,A]`` node indices (-1 = none).
+    Returns ``[L, A*2K]`` candidate indices: the solicited node's bucket
+    ``c = commonBits(self, target)`` (every member is strictly closer to
+    the target than the node itself) plus bucket ``c+1`` — together the
+    node's best approximation of "the 8 closest I know"
+    (``Dht::onFindNode`` src/dht.cpp:3189-3200).  Dead or empty slots
+    return -1s.
+    """
+    n, b_total, k = cfg.n_nodes, cfg.n_buckets, cfg.bucket_k
+    safe = jnp.clip(nid, 0, n - 1)
+    nid_ids = swarm.ids[safe]                                   # [L,A,5]
+    c = common_bits(nid_ids, targets[:, None, :])               # [L,A]
+    c0 = jnp.clip(c, 0, b_total - 1)
+    c1 = jnp.clip(c + 1, 0, b_total - 1)
+    rows0 = swarm.tables[safe, c0]                              # [L,A,K]
+    rows1 = swarm.tables[safe, c1]
+    resp = jnp.concatenate([rows0, rows1], axis=-1)             # [L,A,2K]
+    ok = (nid >= 0) & swarm.alive[safe]
+    resp = jnp.where(ok[..., None], resp, -1)
+    return resp.reshape(resp.shape[0], -1)
+
+
+def _select_alpha(st: LookupState, cfg: SwarmConfig) -> jax.Array:
+    """Indices of the α best unqueried shortlist nodes per lookup."""
+    unq = (st.idx >= 0) & ~st.queried
+    order = jnp.cumsum(unq.astype(jnp.int32), axis=1)
+    key = jnp.where(unq & (order <= cfg.alpha), order,
+                    jnp.int32(cfg.search_width + 1))
+    skey, sidx = jax.lax.sort((key, st.idx), dimension=1, num_keys=1)
+    return jnp.where(skey[:, :cfg.alpha] > cfg.search_width, -1,
+                     sidx[:, :cfg.alpha])
+
+
+def _sync_done(st_idx: jax.Array, st_queried: jax.Array,
+               cfg: SwarmConfig) -> jax.Array:
+    """True where the ``quorum`` closest known nodes are all queried."""
+    head_idx = st_idx[:, :cfg.quorum]
+    head_q = st_queried[:, :cfg.quorum]
+    valid = head_idx >= 0
+    return jnp.all(head_q | ~valid, axis=1) & jnp.any(valid, axis=1)
+
+
+def init_impl(ids: jax.Array, respond, cfg: SwarmConfig,
+              targets: jax.Array, origins: jax.Array) -> LookupState:
+    """Shared lock-step init: seed each lookup from its origin node's
+    own routing table — the reference's search creation consulting
+    local buckets (``Dht::search`` src/dht.cpp:1672-1735).
+
+    ``respond(targets, nid)`` abstracts where routing tables live:
+    local gathers (single chip) or the all_to_all routed exchange
+    (:mod:`opendht_tpu.parallel.sharded`).
+    """
+    l = targets.shape[0]
+    s = cfg.search_width
+    resp = respond(targets, origins[:, None])         # [L,2K]
+    cand_idx = jnp.concatenate(
+        [resp, jnp.full((l, max(0, s - resp.shape[1])), -1, jnp.int32)],
+        axis=1) if resp.shape[1] < s else resp
+    cand_ids = ids[jnp.clip(cand_idx, 0, cfg.n_nodes - 1)]
+    f_idx, f_ids, f_q = merge_shortlists(
+        targets, cand_ids, cand_idx,
+        jnp.zeros_like(cand_idx, bool), keep=s)
+    return LookupState(
+        targets=targets, idx=f_idx, ids=f_ids, queried=f_q,
+        done=jnp.zeros((l,), bool), hops=jnp.zeros((l,), jnp.int32))
+
+
+def step_impl(ids: jax.Array, alive: jax.Array, respond,
+              cfg: SwarmConfig, st: LookupState) -> LookupState:
+    """Shared lock-step solicitation round (vectorized ``searchStep``,
+    src/dht.cpp:1343-1464): select α unqueried, solicit via
+    ``respond``, merge responses, re-sort, check sync quorum."""
+    sel = _select_alpha(st, cfg)                                # [L,A]
+    sel_alive = (sel >= 0) & alive[jnp.clip(sel, 0, cfg.n_nodes - 1)]
+    hit = st.idx[:, :, None] == sel[:, None, :]                 # [L,S,A]
+    hit = hit & (sel[:, None, :] >= 0)
+    # Alive solicited nodes become "queried"; dead ones are evicted
+    # from the shortlist entirely — the reference expires a node after
+    # 3 unanswered attempts and replaces it with the next candidate
+    # (request.h:113, src/dht.cpp:1059-1074).
+    queried = st.queried | jnp.any(hit & sel_alive[:, None, :], axis=2)
+    evict = jnp.any(hit & (~sel_alive & (sel >= 0))[:, None, :], axis=2)
+    idx = jnp.where(evict, -1, st.idx)
+
+    resp = respond(st.targets, sel)                             # [L,A*2K]
+    cand_idx = jnp.concatenate([idx, resp], axis=1)
+    cand_ids = jnp.concatenate(
+        [st.ids, ids[jnp.clip(resp, 0, cfg.n_nodes - 1)]], axis=1)
+    cand_q = jnp.concatenate(
+        [queried, jnp.zeros_like(resp, bool)], axis=1)
+    f_idx, f_ids, f_q = merge_shortlists(
+        st.targets, cand_ids, cand_idx, cand_q, keep=cfg.search_width)
+
+    active = ~st.done & jnp.any(sel >= 0, axis=1)
+    done = st.done | _sync_done(f_idx, f_q, cfg) | ~jnp.any(
+        (f_idx >= 0) & ~f_q, axis=1)
+    return LookupState(
+        targets=st.targets,
+        idx=jnp.where(st.done[:, None], st.idx, f_idx),
+        ids=jnp.where(st.done[:, None, None], st.ids, f_ids),
+        queried=jnp.where(st.done[:, None], st.queried, f_q),
+        done=done,
+        hops=st.hops + active.astype(jnp.int32))
+
+
+def _local_respond(swarm: Swarm, cfg: SwarmConfig):
+    return lambda tg, nid: _respond(swarm, cfg, tg, nid)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def lookup_init(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
+                origins: jax.Array) -> LookupState:
+    return init_impl(swarm.ids, _local_respond(swarm, cfg), cfg,
+                     targets, origins)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def lookup_step(swarm: Swarm, cfg: SwarmConfig,
+                st: LookupState) -> LookupState:
+    return step_impl(swarm.ids, swarm.alive, _local_respond(swarm, cfg),
+                     cfg, st)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
+           key: jax.Array) -> LookupResult:
+    """Run a batch of iterative lookups to completion.
+
+    ``targets``: ``[L,5]``.  Origins are random alive nodes (each
+    lookup is issued "from" a random participant, like the scenario
+    tests' random-node gets, python/tools/dht/tests.py:865-950).
+    """
+    l = targets.shape[0]
+    # Origins are drawn from *alive* nodes: the issuing node exists.
+    logits = jnp.where(swarm.alive, 0.0, -jnp.inf)
+    origins = jax.random.categorical(
+        key, logits, shape=(l,)).astype(jnp.int32)
+    st = lookup_init(swarm, cfg, targets, origins)
+
+    def cond(st):
+        return ~jnp.all(st.done) & (jnp.max(st.hops) < cfg.max_steps)
+
+    st = jax.lax.while_loop(cond, lambda s: lookup_step(swarm, cfg, s), st)
+    found = jnp.where(st.queried[:, :cfg.quorum],
+                      st.idx[:, :cfg.quorum], -1)
+    return LookupResult(found=found, hops=st.hops, done=st.done)
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"))
+def true_closest(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
+                 k: int = 8) -> jax.Array:
+    """Exact alive k-closest (ground truth for recall measurement)."""
+    return closest_nodes_batched(swarm.ids, targets, k,
+                                 valid=swarm.alive)
+
+
+def lookup_recall(swarm: Swarm, cfg: SwarmConfig, result: LookupResult,
+                  targets: jax.Array, k: int = 8) -> jax.Array:
+    """Fraction of the true k closest alive nodes found, per lookup."""
+    truth = true_closest(swarm, cfg, targets, k)                # [L,k]
+    found = result.found                                        # [L,q]
+    match = (truth[:, :, None] == found[:, None, :]) & (
+        truth[:, :, None] >= 0)
+    return jnp.any(match, axis=2).mean(axis=1)

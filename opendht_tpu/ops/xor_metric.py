@@ -116,10 +116,13 @@ def closest_nodes(ids: jax.Array, target: jax.Array, k: int) -> jax.Array:
 
 @partial(jax.jit, static_argnames=("k", "prefilter"))
 def closest_nodes_batched(ids: jax.Array, targets: jax.Array, k: int,
-                          prefilter: int = 32) -> jax.Array:
+                          prefilter: int = 32,
+                          valid: jax.Array | None = None) -> jax.Array:
     """k XOR-closest node indices for a batch of targets.
 
     ``ids``: ``[N,5]``, ``targets``: ``[L,5]`` → ``[L,k]`` indices.
+    ``valid``: optional ``[N]`` bool — excluded rows never appear in
+    the result (they lose both the prefilter and the final sort).
 
     Two-stage: ``lax.top_k`` on the negated first-64-bit surrogate
     distance (cheap, MXU/VPU friendly, avoids sorting the full ``[L,N]``
@@ -127,18 +130,70 @@ def closest_nodes_batched(ids: jax.Array, targets: jax.Array, k: int,
     Exact unless more than ``prefilter`` candidates tie on their first
     64 distance bits (probability ≈ (N/2^64)·prefilter for random ids).
     """
-    # Surrogate: bit-inverted first two distance limbs, as a pair of
-    # uint32 planes packed into one sortable int64-free key: top_k on
-    # limb0 first; ties broken within the shortlist's exact sort.
+    # Surrogate: bit-inverted first distance limb: top_k on limb0;
+    # ties broken within the shortlist's exact sort.
     d0 = jnp.bitwise_xor(ids[None, :, 0], targets[:, 0:1])      # [L,N]
     # top_k wants "largest"; invert so nearer = larger.  int32 view keeps
     # order if we flip the sign bit.
     surro = (jnp.bitwise_xor(d0, jnp.uint32(0xFFFFFFFF))
              ^ jnp.uint32(0x80000000)).astype(jnp.int32)
+    if valid is not None:
+        surro = jnp.where(valid[None, :], surro, jnp.int32(-2**31))
     _, short = jax.lax.top_k(surro, prefilter)                   # [L,P]
     cand = ids[short]                                            # [L,P,5]
+    if valid is not None:
+        # Push excluded shortlist rows to the back of the exact sort
+        # and mark them -1.
+        inval = ~valid[short]
+        cand = jnp.where(inval[..., None], SENTINEL_LIMB, cand)
+        short = jnp.where(inval, -1, short)
     _, sidx = sort_by_distance(cand, targets, short)
     return sidx[:, :k]
+
+
+def lex_less(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lexicographic ``a < b`` over packed id arrays ``[..., 5]``.
+
+    Same comparator as :func:`xor_less` (5-limb big-endian order equals
+    160-bit integer order); named separately because it compares ids,
+    not distances — the reference's ``InfoHash::cmp``
+    (include/opendht/infohash.h:101-104).
+    """
+    return xor_less(a, b)
+
+
+def lex_searchsorted(sorted_ids: jax.Array, queries: jax.Array,
+                     side: str = "left") -> jax.Array:
+    """Vectorized binary search over lexicographically sorted packed ids.
+
+    ``sorted_ids``: ``[N,5]`` ascending; ``queries``: ``[...,5]``.
+    Returns insertion positions (int32), like ``np.searchsorted`` but
+    with the 160-bit 5-limb comparator.  O(log N) gather steps under
+    ``jit`` — the device equivalent of walking the reference's ordered
+    bucket list (``RoutingTable::findBucket``,
+    src/routing_table.cpp:113-127).
+    """
+    n = sorted_ids.shape[0]
+    steps = max(1, (n - 1).bit_length() + 1) if n > 1 else 1
+    batch = queries.shape[:-1]
+    lo = jnp.zeros(batch, jnp.int32)
+    hi = jnp.full(batch, n, jnp.int32)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) // 2
+        mid_ids = sorted_ids[jnp.clip(mid, 0, n - 1)]
+        if side == "left":
+            go_right = lex_less(mid_ids, queries)
+        else:
+            go_right = ~lex_less(queries, mid_ids)
+        go_right = go_right & (lo < hi)
+        new_lo = jnp.where(go_right, mid + 1, lo)
+        new_hi = jnp.where(go_right, hi, jnp.where(lo < hi, mid, hi))
+        return new_lo, new_hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
 
 
 def merge_shortlists(target: jax.Array, cand_ids: jax.Array,

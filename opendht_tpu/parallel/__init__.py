@@ -1,0 +1,4 @@
+"""Mesh sharding: data-parallel and table-sharded swarm lookups."""
+
+from .mesh import AXIS, batch_sharded, make_mesh, replicated  # noqa: F401
+from .sharded import data_parallel_lookup, sharded_lookup  # noqa: F401

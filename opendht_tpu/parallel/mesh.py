@@ -1,0 +1,40 @@
+"""Device-mesh helpers for the swarm engine.
+
+The reference scales by running more processes on more hosts
+(python/tools/dht/network.py's netns clusters); the TPU design scales
+by sharding the swarm's tensors over a ``jax.sharding.Mesh`` and
+letting XLA insert ICI collectives.  One 1-D axis (``"swarm"``) is
+enough for both parallel modes:
+
+* **data-parallel lookups** — node state replicated, the lookup batch
+  axis sharded (small swarms, many lookups);
+* **table-sharded lookups** — routing tables (the HBM-dominant array:
+  ``N·B·K·4`` bytes) sharded on the node axis, with queries routed to
+  owner shards via ``all_to_all`` (see ``sharded.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "swarm"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = AXIS) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, ndim: int, axis: str = AXIS) -> NamedSharding:
+    """Shard the leading axis; replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))

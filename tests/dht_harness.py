@@ -35,16 +35,28 @@ class SimCluster:
     def _host(self, i: int) -> str:
         return f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
 
-    def add_node(self, i: Optional[int] = None, **dht_kwargs) -> Dht:
+    def _node_wiring(self, i: Optional[int]):
+        """Shared per-node wiring: (index, socket, node id, rng)."""
         if i is None:
             i = len(self.nodes)
-        host = self._host(i)
-        sock = self.net.socket(host, 4222)
-        dht = Dht(sock, None,
-                  DhtConfig(node_id=InfoHash.get(f"node-{self.seed}-{i}")),
-                  scheduler=self.scheduler,
-                  rng=random.Random(self.seed * 10007 + i),
-                  **dht_kwargs)
+        sock = self.net.socket(self._host(i), 4222)
+        node_id = InfoHash.get(f"node-{self.seed}-{i}")
+        rng = random.Random(self.seed * 10007 + i)
+        return i, sock, node_id, rng
+
+    def add_node(self, i: Optional[int] = None, **dht_kwargs) -> Dht:
+        i, sock, node_id, rng = self._node_wiring(i)
+        dht = Dht(sock, None, DhtConfig(node_id=node_id),
+                  scheduler=self.scheduler, rng=rng, **dht_kwargs)
+        self.nodes.append(dht)
+        return dht
+
+    def add_secure_node(self, identity=None, i: Optional[int] = None):
+        """Add a SecureDht node (crypto overlay) to the same network."""
+        from opendht_tpu.crypto.securedht import SecureDht, SecureDhtConfig
+        i, sock, node_id, rng = self._node_wiring(i)
+        cfg = SecureDhtConfig(DhtConfig(node_id=node_id), identity)
+        dht = SecureDht(sock, None, cfg, scheduler=self.scheduler, rng=rng)
         self.nodes.append(dht)
         return dht
 

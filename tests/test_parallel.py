@@ -1,0 +1,54 @@
+"""Mesh-sharded lookups on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.models.swarm import (
+    SwarmConfig, build_swarm, churn, lookup_recall,
+)
+from opendht_tpu.parallel import (
+    data_parallel_lookup, make_mesh, sharded_lookup,
+)
+
+CFG = SwarmConfig.for_nodes(2048)
+
+
+@pytest.fixture(scope="module")
+def swarm():
+    return build_swarm(jax.random.PRNGKey(7), CFG)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    return make_mesh(8)
+
+
+def test_data_parallel_lookup(swarm, mesh):
+    targets = jax.random.bits(jax.random.PRNGKey(1), (64, 5), jnp.uint32)
+    res = data_parallel_lookup(swarm, CFG, targets, jax.random.PRNGKey(2),
+                               mesh)
+    assert bool(jnp.all(res.done))
+    recall = np.asarray(lookup_recall(swarm, CFG, res, targets))
+    assert recall.mean() > 0.9
+
+
+def test_sharded_lookup_matches_quality(swarm, mesh):
+    targets = jax.random.bits(jax.random.PRNGKey(3), (64, 5), jnp.uint32)
+    res = sharded_lookup(swarm, CFG, targets, jax.random.PRNGKey(4), mesh)
+    assert bool(jnp.all(res.done))
+    hops = np.asarray(res.hops)
+    assert np.median(hops) <= 12
+    recall = np.asarray(lookup_recall(swarm, CFG, res, targets))
+    assert recall.mean() > 0.9, recall.mean()
+
+
+def test_sharded_lookup_under_churn(swarm, mesh):
+    dead = churn(swarm, jax.random.PRNGKey(9), 0.25, CFG)
+    targets = jax.random.bits(jax.random.PRNGKey(5), (64, 5), jnp.uint32)
+    res = sharded_lookup(dead, CFG, targets, jax.random.PRNGKey(6), mesh)
+    recall = np.asarray(lookup_recall(dead, CFG, res, targets))
+    assert recall.mean() > 0.7, recall.mean()

@@ -1,0 +1,166 @@
+"""SecureDht overlay: signed/encrypted puts, cert discovery, policies.
+
+Scenario parity with the reference's securedht semantics
+(src/securedht.cpp); RSA keygen is slow, so identities are generated
+once per module at reduced key length.
+"""
+
+import pytest
+
+from opendht_tpu.core.value import Value
+from opendht_tpu.crypto.identity import generate_identity
+from opendht_tpu.crypto.securedht import (
+    check_value_signature, encrypt_value, sign_value,
+)
+from opendht_tpu.utils.infohash import InfoHash
+
+from dht_harness import SimCluster
+
+
+@pytest.fixture(scope="module")
+def identities():
+    return [generate_identity(f"node{i}", key_length=1024)
+            for i in range(2)]
+
+
+@pytest.fixture()
+def cluster(identities):
+    c = SimCluster(0, seed=5)
+    a = c.add_secure_node(identities[0])
+    b = c.add_secure_node(identities[1])
+    for _ in range(2):
+        c.add_node()
+    c.interconnect()
+    c.run(2.0)
+    return c, a, b
+
+
+def test_value_sign_verify(identities):
+    v = Value(b"hello", 0, value_id=7)
+    sign_value(identities[0].key, v)
+    assert v.is_signed()
+    assert check_value_signature(v)
+    v.data = b"tampered"
+    assert not check_value_signature(v)
+
+
+def test_value_encrypt_decrypt_roundtrip(identities):
+    alice, bob = identities
+    v = Value(b"secret", 0, value_id=9)
+    ev = encrypt_value(v, alice.key, bob.key.get_public_key())
+    assert ev.is_encrypted() and not ev.data
+
+    # Receiver-side decrypt via a SecureDht instance.
+    c = SimCluster(0, seed=9)
+    bob_node = c.add_secure_node(bob)
+    dv = bob_node.decrypt(ev)
+    assert dv.data == b"secret"
+    assert dv.owner.get_id() == alice.key.get_public_key().get_id()
+    assert dv.recipient == bob.key.get_public_key().get_id()
+
+
+def test_put_signed_roundtrip(cluster):
+    c, a, b = cluster
+    h = InfoHash.get("signed-key")
+    done = {}
+    a.put_signed(h, Value(b"signed-data", 0),
+                 lambda ok, nodes: done.update(ok=ok))
+    assert c.run_until(lambda: "ok" in done, 20)
+    assert done["ok"]
+
+    got = []
+    b.get(h, lambda vals: got.extend(vals) or True)
+    assert c.run_until(lambda: got, 20)
+    assert got[0].data == b"signed-data"
+    assert got[0].is_signed() and check_value_signature(got[0])
+
+
+def test_put_signed_bumps_seq(cluster):
+    c, a, b = cluster
+    h = InfoHash.get("seq-key")
+    v1 = Value(b"v1", 0, value_id=42)
+    done1 = {}
+    a.put_signed(h, v1, lambda ok, n: done1.update(ok=ok))
+    assert c.run_until(lambda: "ok" in done1, 20)
+
+    v2 = Value(b"v2", 0, value_id=42)
+    done2 = {}
+    a.put_signed(h, v2, lambda ok, n: done2.update(ok=ok))
+    assert c.run_until(lambda: "ok" in done2, 20)
+    assert v2.seq > v1.seq
+
+    got = []
+    b.get(h, lambda vals: got.extend(vals) or True)
+    assert c.run_until(lambda: got, 20)
+    newest = max(got, key=lambda v: v.seq)
+    assert newest.data == b"v2"
+
+
+def test_put_encrypted_roundtrip(cluster):
+    c, a, b = cluster
+    # b's certificate is announced at its key id at startup; give the
+    # announcement time to propagate, then a encrypts "to" b.
+    c.run(2.0)
+    h = InfoHash.get("enc-key")
+    done = {}
+    a.put_encrypted(h, b.get_id(), Value(b"for-bob", 0),
+                    lambda ok, nodes: done.update(ok=ok))
+    assert c.run_until(lambda: "ok" in done, 30)
+    assert done["ok"]
+
+    got = []
+    b.get(h, lambda vals: got.extend(vals) or True)
+    assert c.run_until(lambda: got, 20)
+    assert got[0].data == b"for-bob"
+
+    # A third (plain) node sees only the opaque cypher.
+    other = c.nodes[2]
+    raw = []
+    other.get(h, lambda vals: raw.extend(vals) or True)
+    assert c.run_until(lambda: raw, 20)
+    assert raw[0].is_encrypted()
+
+
+def test_encrypted_value_hidden_from_other_secure_node(cluster,
+                                                       identities):
+    c, a, b = cluster
+    h = InfoHash.get("private-key-2")
+    done = {}
+    a.put_encrypted(h, b.get_id(), Value(b"private", 0),
+                    lambda ok, nodes: done.update(ok=ok))
+    assert c.run_until(lambda: "ok" in done, 30)
+
+    # a itself is not the recipient: its secure get must filter it out.
+    got = []
+    finished = {}
+    a.get(h, lambda vals: got.extend(vals) or True,
+          lambda ok, n: finished.update(ok=ok))
+    assert c.run_until(lambda: "ok" in finished, 20)
+    assert not got
+
+
+def test_find_certificate(cluster):
+    c, a, b = cluster
+    c.run(2.0)
+    res = {}
+    a.find_certificate(b.certificate.get_id(),
+                       lambda crt: res.update(crt=crt))
+    assert c.run_until(lambda: "crt" in res and res["crt"] is not None, 30)
+    assert res["crt"].get_id() == b.certificate.get_id()
+
+
+def test_forged_signature_rejected_by_store_policy(cluster, identities):
+    c, a, b = cluster
+    h = InfoHash.get("forged")
+    v = Value(b"legit", 1)  # DhtMessage type: secured
+    v.id = 77
+    sign_value(a.key, v)
+    v.data = b"forged"  # break the signature after signing
+    done = {}
+    # bypass put_signed (which would re-sign): direct put
+    a.put(h, v, lambda ok, nodes: done.update(ok=ok))
+    c.run_until(lambda: "ok" in done, 20)
+    # The SecureDht node verifies store policies and must reject it;
+    # plain Dht nodes store blindly (same split as the reference, where
+    # only SecureDht wraps types with signature-checking policies).
+    assert b.get_local(h) == []

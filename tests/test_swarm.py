@@ -1,0 +1,125 @@
+"""SimSwarm engine: construction invariants, lookup convergence, churn."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.models.swarm import (
+    SwarmConfig, build_swarm, bucket_range, churn, lookup, lookup_recall,
+    true_closest,
+)
+from opendht_tpu.ops.xor_metric import common_bits, lex_searchsorted
+
+
+CFG = SwarmConfig.for_nodes(2048)
+
+
+@pytest.fixture(scope="module")
+def swarm():
+    return build_swarm(jax.random.PRNGKey(7), CFG)
+
+
+def _to_int(limbs):
+    return int.from_bytes(
+        b"".join(int(x).to_bytes(4, "big") for x in limbs), "big")
+
+
+def test_ids_sorted(swarm):
+    ids = np.asarray(swarm.ids)
+    vals = [_to_int(row) for row in ids]
+    assert vals == sorted(vals)
+    assert len(set(vals)) == len(vals)  # unique with overwhelming prob
+
+
+def test_searchsorted_matches_python(swarm):
+    ids = np.asarray(swarm.ids)
+    vals = [_to_int(row) for row in ids]
+    rng = np.random.default_rng(3)
+    queries = rng.integers(0, 2**32, size=(50, 5), dtype=np.uint32)
+    got_l = np.asarray(lex_searchsorted(swarm.ids, jnp.asarray(queries),
+                                        side="left"))
+    got_r = np.asarray(lex_searchsorted(swarm.ids, jnp.asarray(queries),
+                                        side="right"))
+    import bisect
+    for i, q in enumerate(queries):
+        qi = _to_int(q)
+        assert got_l[i] == bisect.bisect_left(vals, qi)
+        assert got_r[i] == bisect.bisect_right(vals, qi)
+
+
+def test_bucket_members_share_exact_prefix(swarm):
+    ids = swarm.ids
+    tables = np.asarray(swarm.tables)
+    n, b_total, k = tables.shape
+    rng = np.random.default_rng(0)
+    for _ in range(40):
+        i = int(rng.integers(n))
+        b = int(rng.integers(b_total))
+        for kk in range(k):
+            j = tables[i, b, kk]
+            if j < 0:
+                continue
+            cb = int(common_bits(ids[i], ids[j]))
+            if b == b_total - 1:
+                # deepest bucket is inclusive (unsplit tail): >= b bits
+                assert cb >= b or j == i, (i, b, j, cb)
+            else:
+                assert cb == b, (i, b, j, cb)
+
+
+def test_bucket_range_consistency(swarm):
+    # every bucket range [lo,hi) must contain exactly the ids sharing
+    # b prefix bits with the node
+    ids = swarm.ids
+    lo, hi = bucket_range(ids, ids[100:101], jnp.int32(3))
+    lo, hi = int(lo[0]), int(hi[0])
+    cb_all = np.asarray(common_bits(ids, ids[100]))
+    members = set(np.nonzero(cb_all == 3)[0].tolist())
+    assert members == set(range(lo, hi))
+
+
+def test_lookup_converges_with_high_recall(swarm):
+    l = 64
+    key = jax.random.PRNGKey(1)
+    targets = jax.random.bits(key, (l, 5), jnp.uint32)
+    res = lookup(swarm, CFG, targets, jax.random.PRNGKey(2))
+    assert bool(jnp.all(res.done))
+    hops = np.asarray(res.hops)
+    assert hops.max() <= CFG.max_steps
+    # log2(2048) = 11; bucket-granular lookups should need few hops
+    assert np.median(hops) <= 12
+    recall = np.asarray(lookup_recall(swarm, CFG, res, targets))
+    assert recall.mean() > 0.9, recall.mean()
+
+
+def test_lookup_finds_exact_node_for_member_targets(swarm):
+    # Looking up an existing node's own id must find that node.
+    targets = swarm.ids[::97][:16]
+    res = lookup(swarm, CFG, targets, jax.random.PRNGKey(5))
+    found = np.asarray(res.found)
+    want = np.arange(0, 2048, 97)[:16]
+    for li in range(16):
+        assert want[li] in found[li], li
+
+
+def test_lookup_under_churn(swarm):
+    dead = churn(swarm, jax.random.PRNGKey(9), 0.25, CFG)
+    assert 0.6 < float(dead.alive.mean()) < 0.85
+    l = 48
+    targets = jax.random.bits(jax.random.PRNGKey(11), (l, 5), jnp.uint32)
+    res = lookup(dead, CFG, targets, jax.random.PRNGKey(12))
+    recall = np.asarray(lookup_recall(dead, CFG, res, targets))
+    # convergence degrades under 25% churn but must stay useful
+    assert recall.mean() > 0.7, recall.mean()
+
+
+def test_true_closest_matches_bruteforce(swarm):
+    ids = np.asarray(swarm.ids)
+    t = jax.random.bits(jax.random.PRNGKey(20), (3, 5), jnp.uint32)
+    got = np.asarray(true_closest(swarm, CFG, t, k=8))
+    for li in range(3):
+        ti = _to_int(np.asarray(t)[li])
+        order = sorted(range(len(ids)), key=lambda i: _to_int(ids[i]) ^ ti)
+        assert got[li].tolist() == order[:8]
