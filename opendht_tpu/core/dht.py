@@ -264,7 +264,11 @@ class Search:
         pos = len(self.nodes)
         for i in range(len(self.nodes) - 1, -1, -1):
             sn = self.nodes[i]
-            if sn.node is node:
+            # Same object, or same id (the cache normally guarantees one
+            # object per id; equal-id match is defense in depth — two
+            # SearchNodes for one id would each count toward the sync
+            # quorum while only one can ever reply).
+            if sn.node is node or sn.node.id == node.id:
                 found = sn
                 break
             if InfoHash.xor_cmp(node.id, sn.node.id, target) > 0:
@@ -1406,7 +1410,7 @@ class Dht:
         from .value import Where as _Where
         q = Query(None, where if where is not None else _Where())
         op = {"done": False, "ok": False, "done4": False, "done6": False,
-              "values": [], "nodes": []}
+              "ok4": False, "ok6": False, "values": [], "nodes": []}
         ff = f_chain_and(f, q.where.get_filter())
 
         def add_values(values):
@@ -1434,16 +1438,23 @@ class Dht:
                 return
             op["nodes"].extend(nodes)
             if op["ok"] or (op["done4"] and op["done6"]):
+                # ok = cancelled-satisfied OR either search completed —
+                # NOT "values found": a completed search over a missing
+                # key reports success with no values
+                # (ref: doneCallbackWrapper src/dht.cpp:1983-1993).
+                ok = op["ok"] or op["ok4"] or op["ok6"]
                 op["done"] = True
                 if done_cb:
-                    done_cb(op["ok"] or bool(op["values"]), op["nodes"])
+                    done_cb(ok, op["nodes"])
 
         def done4(ok, nodes):
             op["done4"] = True
+            op["ok4"] = ok
             done_wrapper(nodes)
 
         def done6(ok, nodes):
             op["done6"] = True
+            op["ok6"] = ok
             done_wrapper(nodes)
 
         # answer locally first
@@ -1460,7 +1471,7 @@ class Dht:
         """Remote-filtered field query (ref: Dht::query src/dht.cpp:2055-2103)."""
         q = q or Query()
         op = {"done": False, "ok": False, "done4": False, "done6": False,
-              "values": [], "nodes": []}
+              "ok4": False, "ok6": False, "values": [], "nodes": []}
         f = q.where.get_filter()
 
         def add_fields(fields):
@@ -1488,16 +1499,23 @@ class Dht:
                 return
             op["nodes"].extend(nodes)
             if op["ok"] or (op["done4"] and op["done6"]):
+                # ok = cancelled-satisfied OR either search completed —
+                # NOT "values found": a completed search over a missing
+                # key reports success with no values
+                # (ref: doneCallbackWrapper src/dht.cpp:1983-1993).
+                ok = op["ok"] or op["ok4"] or op["ok6"]
                 op["done"] = True
                 if done_cb:
-                    done_cb(op["ok"] or bool(op["values"]), op["nodes"])
+                    done_cb(ok, op["nodes"])
 
         def done4(ok, nodes):
             op["done4"] = True
+            op["ok4"] = ok
             done_wrapper(nodes)
 
         def done6(ok, nodes):
             op["done6"] = True
+            op["ok6"] = ok
             done_wrapper(nodes)
 
         local = self.get_local(info_hash, f)
@@ -1805,6 +1823,67 @@ class Dht:
             self.total_store_size += size_diff
             self.total_values += count_diff
         return announced
+
+    # ------------------------------------------------------------------ #
+    # log dumps (ref: dumpBucket/dumpSearch/getStorageLog                #
+    # src/dht.cpp:2497-2730)                                             #
+    # ------------------------------------------------------------------ #
+
+    def get_routing_table_log(self, af: int) -> str:
+        now = self.scheduler.time()
+        out = []
+        for b in self.buckets(af).buckets:
+            line = f"Bucket {b.first.hex()[:8]}.. "
+            if b.cached is not None:
+                line += "(cached) "
+            out.append(line)
+            for n in b.nodes:
+                age = now - n.time if n.time > TIME_INVALID else -1
+                state = ("good" if n.is_good(now)
+                         else "expired" if n.is_expired() else "dubious")
+                out.append(f"    Node {n.id} {n.addr.host}:{n.addr.port}"
+                           f" [{state}] heard {age:.0f}s ago")
+        return "\n".join(out)
+
+    def get_searches_log(self, af: int = 0) -> str:
+        now = self.scheduler.time()
+        out = []
+        for a, srs in ((AF_INET, self.searches4), (AF_INET6,
+                                                   self.searches6)):
+            if af and a != af:
+                continue
+            for sr in srs.values():
+                out.append(
+                    f"Search IPv{a} {sr.id} "
+                    f"{'done' if sr.done else 'expired' if sr.expired else 'active'}"
+                    f" synced={sr.is_synced(now)}"
+                    f" gets={len(sr.callbacks)}"
+                    f" announces={len(sr.announce)}"
+                    f" listeners={len(sr.listeners)}")
+                for sn in sr.nodes:
+                    flags = ""
+                    flags += "s" if sn.is_synced(now) else "-"
+                    flags += "b" if sn.is_bad() else "-"
+                    flags += "c" if sn.candidate else "-"
+                    out.append(f"    {sn.node.id} [{flags}]")
+        return "\n".join(out)
+
+    def get_storage_log(self) -> str:
+        now = self.scheduler.time()
+        out = [f"Storage: {len(self.store)} keys, "
+               f"{self.total_store_size} bytes, "
+               f"{self.total_values} values"]
+        for h, st in self.store.items():
+            listeners = sum(len(m) for m in st.listeners.values())
+            out.append(f"  {h}: {len(st.values)} values, "
+                       f"{st.total_size} B, {listeners} remote / "
+                       f"{len(st.local_listeners)} local listeners")
+            for vs in st.values:
+                t = self.get_type(vs.value.type)
+                exp = vs.created + t.expiration - now
+                out.append(f"      id {vs.value.id:016x} type {t.name} "
+                           f"{vs.value.size()} B, expires in {exp:.0f}s")
+        return "\n".join(out)
 
     # ------------------------------------------------------------------ #
     # import / export (checkpoint-resume, ref: src/dht.cpp:3029-3121)    #

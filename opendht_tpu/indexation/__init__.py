@@ -1,0 +1,3 @@
+"""Secondary indexes over the DHT: prefix hash tree (PHT)."""
+
+from .pht import Cache, IndexEntry, IndexValue, Pht, Prefix  # noqa: F401

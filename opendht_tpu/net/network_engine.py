@@ -486,10 +486,20 @@ class NetworkEngine:
             node = req.node
             if node.id != msg.id and msg.id:
                 if not node.id:
-                    node.id = msg.id
+                    # Reply to a message sent before we knew the node id
+                    # (bootstrap ping): swap in the canonical cached Node
+                    # so one id maps to one object everywhere
+                    # (ref: src/network_engine.cpp:470-473).
+                    node = self.cache.get_node(msg.id, from_addr)
+                    req.node = node
                 else:
-                    # id mismatch: node changed identity
-                    node.set_expired()
+                    # Reply from an unexpected node id
+                    # (ref: src/network_engine.cpp:474-479).
+                    node.received(now, req)
+                    self.handler.on_new_node(node, 2)
+                    self.log.w("[node %s] reply from unexpected node",
+                               node.id)
+                    return
 
             if msg.type == MessageType.Error:
                 self.requests.pop(msg.tid, None)

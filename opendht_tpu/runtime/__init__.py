@@ -1,0 +1,3 @@
+"""Runtime facade: threaded DhtRunner over real or virtual transports."""
+
+from .dhtrunner import DhtRunner, DhtRunnerConfig  # noqa: F401

@@ -1,0 +1,1 @@
+"""CLI tools: dhtnode REPL, dhtchat, dhtscanner (ref: tools/*.cpp)."""

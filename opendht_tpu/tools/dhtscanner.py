@@ -1,0 +1,81 @@
+"""dhtscanner: crawl the whole DHT keyspace
+(ref: tools/dhtscanner.cpp:43-113).
+
+Recursively splits the 160-bit keyspace: a search at a target returns
+the closest nodes; when a subtree still yields a full bucket of new
+nodes, both halves at the next depth are scanned too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+from ..core.constants import TARGET_NODES
+from ..utils.infohash import InfoHash
+from .common import add_common_args, start_node
+
+MAX_DEPTH = 12
+
+
+class Scanner:
+    def __init__(self, node):
+        self.node = node
+        self.seen = {}
+        self.pending = 0
+        self.lock = threading.Lock()
+        self.done_evt = threading.Event()
+
+    def step(self, target: InfoHash, depth: int) -> None:
+        """ref: step() tools/dhtscanner.cpp:43-67."""
+        with self.lock:
+            self.pending += 1
+
+        def on_done(ok: bool, nodes) -> None:
+            fresh = 0
+            with self.lock:
+                for n in nodes:
+                    if n.id not in self.seen:
+                        self.seen[n.id] = n.addr
+                        fresh += 1
+            if ok and fresh >= TARGET_NODES and depth < MAX_DEPTH:
+                for bit in (False, True):
+                    self.step(target.set_bit(depth + 1, bit), depth + 1)
+            with self.lock:
+                self.pending -= 1
+                if self.pending == 0:
+                    self.done_evt.set()
+
+        self.node.get(target, lambda vals: True, on_done)
+
+    def scan(self) -> dict:
+        t0 = time.monotonic()
+        for bit in (False, True):
+            self.step(InfoHash.get_random().set_bit(0, bit), 0)
+        self.done_evt.wait()
+        dt = time.monotonic() - t0
+        print(f"Scan complete: {len(self.seen)} nodes in {dt:.1f}s")
+        return self.seen
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dhtscanner", description=__doc__)
+    add_common_args(ap)
+    ap.add_argument("--wait", type=float, default=3.0,
+                    help="seconds to wait for bootstrap before scanning")
+    args = ap.parse_args(argv)
+    node = start_node(args)
+    time.sleep(args.wait)
+    scanner = Scanner(node)
+    nodes = scanner.scan()
+    for nid, addr in sorted(nodes.items()):
+        print(f"{nid} {addr.host}:{addr.port}")
+    node.shutdown()
+    node.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,15 +32,18 @@ def test_put_get_roundtrip_small_net():
     assert any(v.data == b"hello dht" for v in got)
 
 
-def test_get_missing_key_completes_false():
+def test_get_missing_key_completes_ok_with_no_values():
+    # A completed search over a missing key reports success with no
+    # values (ref: doneCallbackWrapper src/dht.cpp:1983-1993).
     c = SimCluster(6)
     c.bootstrap_all()
     c.run(2.0)
     got, done = [], []
     c.nodes[2].get(InfoHash.get("nothing-here"),
-                   lambda vals: True,
+                   lambda vals: got.extend(vals) or True,
                    lambda ok, nodes: done.append(ok))
     assert c.run_until(lambda: done, 30.0)
+    assert done == [True]
     assert got == []
 
 

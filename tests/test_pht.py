@@ -1,0 +1,122 @@
+"""PHT indexation over the virtual-network DHT (PhtTest parity:
+ref python/tools/dht/tests.py:218-362)."""
+
+import random
+
+import pytest
+
+from opendht_tpu.indexation.pht import (
+    MAX_NODE_ENTRY_COUNT, Pht, Prefix,
+)
+from opendht_tpu.utils.infohash import InfoHash
+
+from dht_harness import SimCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = SimCluster(6, seed=3)
+    c.interconnect()
+    c.run(2.0)
+    return c
+
+
+def make_pht(c, node=0, name="test"):
+    return Pht(name, {"id": 8}, c.nodes[node],
+               rng=random.Random(17))
+
+
+def test_prefix_basics():
+    p = Prefix(b"\xF0", 8)
+    assert [p.is_content_bit_active(i) for i in range(8)] == \
+        [True] * 4 + [False] * 4
+    assert p.get_prefix(4).size == 4
+    assert p.get_prefix(-4).size == 4
+    sib = p.get_sibling()
+    assert sib.is_content_bit_active(7) != p.is_content_bit_active(7)
+    assert p.hash() != p.get_prefix(4).hash()
+    assert Prefix.common_bits(p, sib) == 7
+
+
+def test_zcurve_interleaves():
+    a = Prefix(b"\xFF", 8, b"\xFF")
+    b = Prefix(b"\x00", 8, b"\xFF")
+    z = Pht.zcurve([a, b])
+    assert z.size == 16
+    # alternating bits 1,0,1,0...
+    assert all(z.is_content_bit_active(i) == (i % 2 == 0)
+               for i in range(16))
+
+
+def test_linearize_distinguishes_prefix_keys(cluster):
+    pht = make_pht(cluster)
+    p1 = pht.linearize({"id": b"ab"})
+    p2 = pht.linearize({"id": b"ab\x00"})
+    assert p1.content != p2.content
+
+
+def test_insert_lookup_roundtrip(cluster):
+    c = cluster
+    pht = make_pht(c)
+    h = InfoHash.get("entry-1")
+    done = {}
+    pht.insert({"id": b"hello"}, (h, 1), lambda ok: done.update(ok=ok))
+    assert c.run_until(lambda: "ok" in done, 60)
+    assert done["ok"]
+
+    # Lookup from a different node (fresh cache).
+    pht2 = make_pht(c, node=1)
+    found = {}
+    pht2.lookup({"id": b"hello"},
+                lambda vals, p: found.update(vals=vals),
+                lambda ok: found.update(done=ok))
+    assert c.run_until(lambda: "done" in found, 60)
+    assert found["done"]
+    assert (h, 1) in found.get("vals", [])
+
+
+def test_lookup_missing_key_empty(cluster):
+    c = cluster
+    pht = make_pht(c)
+    done = {}
+    pht.insert({"id": b"exists"}, (InfoHash.get("e"), 1),
+               lambda ok: done.update(ok=ok))
+    assert c.run_until(lambda: "ok" in done, 60)
+
+    found = {}
+    pht2 = make_pht(c, node=2)
+    pht2.lookup({"id": b"missing!"},
+                lambda vals, p: found.update(vals=vals),
+                lambda ok: found.update(done=ok))
+    assert c.run_until(lambda: "done" in found, 60)
+    assert found.get("vals", []) == []
+
+
+def test_multiple_inserts_all_found(cluster):
+    c = cluster
+    pht = make_pht(c)
+    keys = [f"k{i}".encode() for i in range(8)]
+    state = {"done": 0}
+    for i, k in enumerate(keys):
+        pht.insert({"id": k}, (InfoHash.get(k.decode()), i),
+                   lambda ok: state.update(done=state["done"] + 1))
+    assert c.run_until(lambda: state["done"] == len(keys), 120)
+
+    pht2 = make_pht(c, node=3)
+    hits = {}
+    for i, k in enumerate(keys):
+        def mk(i=i, k=k):
+            def cb(vals, p):
+                if (InfoHash.get(k.decode()), i) in vals:
+                    hits[k] = True
+            return cb
+        pht2.lookup({"id": k}, mk(), None)
+    assert c.run_until(lambda: len(hits) == len(keys), 120), hits
+
+
+def test_invalid_key_raises(cluster):
+    pht = make_pht(cluster)
+    with pytest.raises(ValueError):
+        pht.linearize({"wrong": b"x"})
+    with pytest.raises(ValueError):
+        pht.linearize({"id": b"way-too-long-for-spec"})
