@@ -658,8 +658,9 @@ class Dht:
     def _token_match(self, token: bytes, addr: SockAddr) -> bool:
         if len(token) != TOKEN_SIZE:
             return False
-        return (token == self._make_token(addr, False)
-                or token == self._make_token(addr, True))
+        from ..native import token_eq
+        return (token_eq(token, self._make_token(addr, False))
+                or token_eq(token, self._make_token(addr, True)))
 
     def _rotate_secrets(self) -> None:
         self.oldsecret = self.secret

@@ -1,0 +1,88 @@
+"""Benchmark CLI over the scenario suite + the TPU swarm engine.
+
+Parity with the reference's ``python/tools/benchmark.py`` (WorkBench
+:37-143, CLI :145-240):
+
+    python -m opendht_tpu.harness.benchmark --performance -t gets
+    python -m opendht_tpu.harness.benchmark --persistence -t delete
+    python -m opendht_tpu.harness.benchmark --swarm -n 100000 -l 10000
+
+The ``--swarm`` mode runs the device-resident lock-step engine
+(opendht_tpu.models.swarm) instead of the event-driven cluster — the
+configuration the reference could never reach.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .scenarios import SCENARIOS
+
+
+def run_scenario(name: str, args) -> dict:
+    fn = SCENARIOS[name]
+    t0 = time.monotonic()
+    kw = {}
+    if args.node_num is not None:
+        kw["n_nodes"] = args.node_num
+    if args.seed is not None:
+        kw["seed"] = args.seed
+    out = fn(**kw)
+    out["scenario"] = name
+    out["wall_s"] = round(time.monotonic() - t0, 2)
+    return out
+
+
+def run_swarm(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..models.swarm import SwarmConfig, build_swarm, lookup
+
+    cfg = SwarmConfig.for_nodes(args.node_num
+                                if args.node_num is not None else 100_000)
+    swarm = build_swarm(jax.random.PRNGKey(args.seed
+                                        if args.seed is not None else 0), cfg)
+    targets = jax.random.bits(jax.random.PRNGKey(1),
+                              (args.lookups, 5), jnp.uint32)
+    res = lookup(swarm, cfg, targets, jax.random.PRNGKey(2))
+    jax.block_until_ready(res.found)
+    t0 = time.monotonic()
+    res = lookup(swarm, cfg, targets, jax.random.PRNGKey(3))
+    jax.block_until_ready(res.found)
+    dt = time.monotonic() - t0
+    return {
+        "scenario": "swarm",
+        "n_nodes": cfg.n_nodes,
+        "n_lookups": args.lookups,
+        "lookups_per_sec": round(args.lookups / dt, 1),
+        "median_hops": float(np.median(np.asarray(res.hops))),
+        "done_frac": float(np.asarray(res.done).mean()),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="benchmark", description=__doc__)
+    ap.add_argument("--performance", action="store_true")
+    ap.add_argument("--persistence", action="store_true")
+    ap.add_argument("--swarm", action="store_true")
+    ap.add_argument("-t", "--test", default="gets",
+                    choices=sorted(SCENARIOS))
+    ap.add_argument("-n", "--node-num", type=int, default=None)
+    ap.add_argument("-l", "--lookups", type=int, default=10_000)
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    if args.swarm:
+        out = run_swarm(args)
+    else:
+        out = run_scenario(args.test, args)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

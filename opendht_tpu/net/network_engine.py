@@ -49,7 +49,7 @@ from ..core.scheduler import Scheduler
 from ..core.value import Query, Value
 from ..utils.infohash import InfoHash
 from ..utils.logger import NONE, Logger
-from ..utils.rate_limiter import RateLimiter
+from ..utils.rate_limiter import RateLimiter, make_rate_limiter
 from ..utils.sockaddr import AF_INET, AF_INET6, SockAddr
 from .request import Request, RequestState
 from .transport import DatagramTransport
@@ -155,7 +155,7 @@ class NetworkEngine:
         self._tid_seq = self.rng.randrange(1 << 16)
         self._sock_seq = self.rng.randrange(1 << 16)
 
-        self.rate_limiter = RateLimiter(MAX_REQUESTS_PER_SEC)
+        self.rate_limiter = make_rate_limiter(MAX_REQUESTS_PER_SEC)
         self.ip_limiters: Dict[str, RateLimiter] = {}
         self.blacklist: Dict[SockAddr, float] = {}
 
@@ -427,7 +427,8 @@ class NetworkEngine:
             key = ":".join(key.split(":")[:4])
         lim = self.ip_limiters.get(key)
         if lim is None:
-            lim = self.ip_limiters[key] = RateLimiter(MAX_REQUESTS_PER_SEC_PER_IP)
+            lim = self.ip_limiters[key] = make_rate_limiter(
+                MAX_REQUESTS_PER_SEC_PER_IP)
         return lim.limit(now) and self.rate_limiter.limit(now)
 
     def _deliver_assembled(self, pm: PartialMessage) -> None:

@@ -30,3 +30,16 @@ class RateLimiter:
         while self._hist and self._hist[0] < now - 1.0:
             self._hist.popleft()
         return len(self._hist)
+
+
+def make_rate_limiter(quota: int):
+    """Prefer the native (C++) sliding-window limiter when available —
+    this sits on the per-packet inbound path (ref:
+    network_engine.h:462)."""
+    try:
+        from ..native import NativeRateLimiter, available
+        if available():
+            return NativeRateLimiter(quota)
+    except Exception:
+        pass
+    return RateLimiter(quota)

@@ -1,107 +1,11 @@
 """Deterministic in-process DHT swarm harness for tests.
 
-The unit-test equivalent of the reference's netns cluster harness
-(ref: python/tools/dht/network.py, virtual_network_builder.py): N Dht cores
-share one virtual clock / scheduler / packet network, so whole-swarm
-scenarios (put/get/listen, churn, persistence) run deterministically in
-milliseconds of real time.
+Thin alias: the real implementation lives in the package
+(:mod:`opendht_tpu.harness.network`), so product code and tests share
+one cluster manager — the unit-test equivalent of the reference's netns
+cluster harness (ref: python/tools/dht/network.py).
 """
 
-from __future__ import annotations
+from opendht_tpu.harness.network import DhtNetwork
 
-import random
-from typing import List, Optional
-
-from opendht_tpu.core.dht import Dht, DhtConfig
-from opendht_tpu.core.scheduler import Scheduler
-from opendht_tpu.net.transport import VirtualNetwork
-from opendht_tpu.utils.clock import VirtualClock
-from opendht_tpu.utils.infohash import InfoHash
-from opendht_tpu.utils.sockaddr import SockAddr
-
-
-class SimCluster:
-    def __init__(self, n: int, seed: int = 1, delay: float = 0.01,
-                 loss: float = 0.0, **dht_kwargs):
-        self.clock = VirtualClock()
-        self.scheduler = Scheduler(self.clock)
-        self.net = VirtualNetwork(self.scheduler, delay=delay, loss=loss,
-                                  seed=seed)
-        self.nodes: List[Dht] = []
-        self.seed = seed
-        for i in range(n):
-            self.add_node(i, **dht_kwargs)
-
-    def _host(self, i: int) -> str:
-        return f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
-
-    def _node_wiring(self, i: Optional[int]):
-        """Shared per-node wiring: (index, socket, node id, rng)."""
-        if i is None:
-            i = len(self.nodes)
-        sock = self.net.socket(self._host(i), 4222)
-        node_id = InfoHash.get(f"node-{self.seed}-{i}")
-        rng = random.Random(self.seed * 10007 + i)
-        return i, sock, node_id, rng
-
-    def add_node(self, i: Optional[int] = None, **dht_kwargs) -> Dht:
-        i, sock, node_id, rng = self._node_wiring(i)
-        dht = Dht(sock, None, DhtConfig(node_id=node_id),
-                  scheduler=self.scheduler, rng=rng, **dht_kwargs)
-        self.nodes.append(dht)
-        return dht
-
-    def add_secure_node(self, identity=None, i: Optional[int] = None):
-        """Add a SecureDht node (crypto overlay) to the same network."""
-        from opendht_tpu.crypto.securedht import SecureDht, SecureDhtConfig
-        i, sock, node_id, rng = self._node_wiring(i)
-        cfg = SecureDhtConfig(DhtConfig(node_id=node_id), identity)
-        dht = SecureDht(sock, None, cfg, scheduler=self.scheduler, rng=rng)
-        self.nodes.append(dht)
-        return dht
-
-    def addr_of(self, dht: Dht) -> SockAddr:
-        i = self.nodes.index(dht)
-        return SockAddr(self._host(i), 4222)
-
-    def bootstrap_all(self, to: int = 0) -> None:
-        """Everyone learns about node ``to``."""
-        target = self.nodes[to]
-        addr = self.addr_of(target)
-        for d in self.nodes:
-            if d is not target:
-                d.insert_node(target.myid, addr)
-
-    def interconnect(self) -> None:
-        """Full mesh knowledge — for tests that skip discovery."""
-        for a in self.nodes:
-            for b in self.nodes:
-                if a is not b:
-                    a.insert_node(b.myid, self.addr_of(b))
-
-    def kill(self, dht: Dht) -> None:
-        """Partition a node away (the node-kill knob)."""
-        self.net.partition(self.addr_of(dht).host, True)
-
-    def revive(self, dht: Dht) -> None:
-        self.net.partition(self.addr_of(dht).host, False)
-
-    def run(self, duration: float, max_step: float = 0.25) -> None:
-        """Advance virtual time, running all due jobs."""
-        end = self.clock.now() + duration
-        while self.clock.now() < end:
-            nxt = self.scheduler.run()
-            if nxt >= end:
-                self.clock.set(end)
-                break
-            self.clock.set(min(end, max(nxt, self.clock.now() + 1e-6)))
-        self.scheduler.run()
-
-    def run_until(self, pred, timeout: float = 30.0,
-                  step: float = 0.05) -> bool:
-        end = self.clock.now() + timeout
-        while self.clock.now() < end:
-            if pred():
-                return True
-            self.run(step)
-        return pred()
+SimCluster = DhtNetwork

@@ -1,0 +1,54 @@
+"""Harness parity: cluster manager ops + scenario suite (fast sizes)."""
+
+from opendht_tpu.harness.network import DhtNetwork
+from opendht_tpu.harness.scenarios import (
+    listen_churn, performance_gets, persistence_delete,
+    persistence_replace,
+)
+
+
+def test_warmup_converges():
+    net = DhtNetwork(12, seed=6)
+    net.bootstrap_all()
+    assert net.warmup()
+
+
+def test_replace_cluster_keeps_size():
+    net = DhtNetwork(12, seed=7)
+    net.bootstrap_all()
+    net.warmup()
+    fresh = net.replace_cluster(3)
+    assert len(fresh) == 3
+    assert len(net.nodes) == 12
+
+
+def test_resize():
+    net = DhtNetwork(8, seed=8)
+    net.bootstrap_all()
+    net.resize(12)
+    assert len(net.nodes) == 12
+    net.resize(6)
+    assert len(net.nodes) == 6
+
+
+def test_scenario_gets_small():
+    out = performance_gets(n_nodes=12, rounds=2, gets_per_round=10,
+                           seed=9)
+    assert out["gets"] == 20
+    assert out["mean_s"] < 10.0
+
+
+def test_scenario_persistence_delete_small():
+    out = persistence_delete(n_nodes=16, n_values=4, seed=10)
+    assert out["stored"] == 4
+    assert out["refound"] >= out["total"] // 2
+
+
+def test_scenario_replace_small():
+    out = persistence_replace(n_nodes=16, seed=11)
+    assert out["survived"] >= out["rounds"] - 1
+
+
+def test_scenario_listen_small():
+    out = listen_churn(n_nodes=12, seed=12)
+    assert out["received"] >= out["sent"] - 1

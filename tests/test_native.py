@@ -1,0 +1,76 @@
+"""Native C++ hot path (dhtcore) vs the pure-Python reference impls."""
+
+import numpy as np
+import pytest
+
+from opendht_tpu import native
+from opendht_tpu.utils.infohash import InfoHash
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(11)
+
+
+def _ids(rng, n):
+    return rng.integers(0, 256, size=(n, 20), dtype=np.uint8).tobytes()
+
+
+def test_common_bits_matches_infohash(rng):
+    for _ in range(50):
+        a = InfoHash.get_random()
+        b = InfoHash.get_random()
+        assert native.common_bits(bytes(a), bytes(b)) == a.common_bits(b)
+    a = InfoHash.get_random()
+    assert native.common_bits(bytes(a), bytes(a)) == 160
+
+
+def test_xor_topk_matches_bruteforce(rng):
+    n = 500
+    blob = _ids(rng, n)
+    target = bytes(InfoHash.get_random())
+    t = int.from_bytes(target, "big")
+    want = sorted(
+        range(n),
+        key=lambda i: int.from_bytes(blob[i * 20:(i + 1) * 20], "big") ^ t
+    )[:8]
+    got = native.xor_topk(blob, n, target, 8)
+    assert got == want
+
+
+def test_xor_topk_k_larger_than_n(rng):
+    blob = _ids(rng, 3)
+    got = native.xor_topk(blob, 3, bytes(InfoHash.get_random()), 8)
+    assert len(got) == 3 and sorted(got) == [0, 1, 2]
+
+
+def test_native_rate_limiter_window():
+    rl = native.NativeRateLimiter(3)
+    assert all(rl.limit(10.0 + i * 0.1) for i in range(3))
+    assert not rl.limit(10.35)          # 4th inside the window
+    assert rl.limit(11.25)              # first hit expired
+
+
+def test_token_eq():
+    assert native.token_eq(b"a" * 64, b"a" * 64)
+    assert not native.token_eq(b"a" * 64, b"a" * 63 + b"b")
+
+
+def test_common_bits_batch_and_xor_sort(rng):
+    n = 64
+    blob = _ids(rng, n)
+    target = bytes(InfoHash.get_random())
+    cb = native.common_bits_batch(blob, n, target)
+    assert len(cb) == n
+    for i in (0, 13, 63):
+        assert cb[i] == native.common_bits(
+            blob[i * 20:(i + 1) * 20], target)
+    order = native.xor_sort(blob, list(range(n)), target)
+    t = int.from_bytes(target, "big")
+    want = sorted(range(n), key=lambda i: int.from_bytes(
+        blob[i * 20:(i + 1) * 20], "big") ^ t)
+    assert order == want
