@@ -1,0 +1,22 @@
+# opendht_tpu build/test entry points (the reference ships CMake +
+# autotools + MSVC, ref CMakeLists.txt:17-22; here the Python package is
+# the product and the only compiled artifact is the native hot path).
+
+NATIVE_SRC := opendht_tpu/native/dhtcore.cpp
+
+.PHONY: all native test bench clean
+
+all: native
+
+native:
+	python -c "from opendht_tpu import native; assert native.available(); print('libdhtcore ready')"
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+clean:
+	rm -f opendht_tpu/native/libdhtcore-*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -35,10 +35,12 @@ def main():
     ap.add_argument("--lookups", type=int, default=100_000)
     ap.add_argument("--repeat", type=int, default=3)
     ap.add_argument("--recall-sample", type=int, default=512)
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture an XLA profiler trace of one timed run")
     args = ap.parse_args()
 
     from opendht_tpu.models.swarm import (
-        SwarmConfig, build_swarm, lookup, true_closest,
+        SwarmConfig, build_swarm, lookup_compact, true_closest,
     )
 
     cfg = SwarmConfig.for_nodes(args.nodes)
@@ -49,14 +51,20 @@ def main():
     targets = jax.random.bits(jax.random.PRNGKey(1), (args.lookups, 5),
                               jnp.uint32)
 
-    # Warmup (compile).
-    res = lookup(swarm, cfg, targets, jax.random.PRNGKey(2))
+    # Warmup (compile — covers the power-of-two compaction sizes too).
+    res = lookup_compact(swarm, cfg, targets, jax.random.PRNGKey(2))
     jax.block_until_ready(res.found)
+
+    if args.profile:
+        with jax.profiler.trace(args.profile):
+            res = lookup_compact(swarm, cfg, targets,
+                                 jax.random.PRNGKey(99))
+            jax.block_until_ready(res.found)
 
     times = []
     for r in range(args.repeat):
         t0 = time.perf_counter()
-        res = lookup(swarm, cfg, targets, jax.random.PRNGKey(3 + r))
+        res = lookup_compact(swarm, cfg, targets, jax.random.PRNGKey(3 + r))
         jax.block_until_ready(res.found)
         times.append(time.perf_counter() - t0)
     dt = min(times)

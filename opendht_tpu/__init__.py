@@ -18,6 +18,27 @@ Reference: sim590/opendht (C++11), see SURVEY.md.
 
 __version__ = "0.1.0"
 
+# Binding-parity surface (ref: python/opendht.pyx exports: InfoHash,
+# Node, NodeSet, Value, PublicKey, Certificate, Identity, DhtConfig,
+# DhtRunner, Pht).
 from .utils.infohash import InfoHash  # noqa: F401
 from .utils.sockaddr import SockAddr  # noqa: F401
 from .core.value import Value, ValueType, Query, Select, Where  # noqa: F401
+from .core.node import Node  # noqa: F401
+from .core.dht import Dht, DhtConfig  # noqa: F401
+from .crypto.identity import (  # noqa: F401
+    Certificate,
+    Identity,
+    PrivateKey,
+    PublicKey,
+    generate_identity,
+)
+from .crypto.securedht import SecureDht, SecureDhtConfig  # noqa: F401
+from .runtime.dhtrunner import DhtRunner, DhtRunnerConfig  # noqa: F401
+from .runtime.nodeset import NodeSet  # noqa: F401
+from .indexation.pht import Pht  # noqa: F401
+from .harness.network import DhtNetwork  # noqa: F401
+
+# The TPU swarm engine (jax-heavy) is intentionally NOT imported here;
+# use ``from opendht_tpu.models import SwarmConfig, build_swarm, lookup``
+# or ``from opendht_tpu.parallel import sharded_lookup``.

@@ -91,6 +91,47 @@ TRUST_REQUEST = ValueType(4, "Certificate trust request", 100 * 24 * 3600)
 ICE_CANDIDATES = ValueType(5, "ICE candidates", 10 * 60)
 CERTIFICATE_TYPE_ID = 8
 
+
+class TrustRequest:
+    """Connectivity/trust handshake payload
+    (ref: include/opendht/default_types.h:105-140)."""
+
+    TYPE = TRUST_REQUEST
+
+    def __init__(self, service: str = "", payload: bytes = b"",
+                 confirm: bool = False):
+        self.service = service
+        self.payload = bytes(payload)
+        self.confirm = confirm
+
+    def pack(self) -> bytes:
+        return msgpack.packb({"s": self.service, "d": self.payload,
+                              "c": self.confirm})
+
+    @classmethod
+    def unpack(cls, blob: bytes) -> "TrustRequest":
+        o = msgpack.unpackb(blob, raw=False, strict_map_key=False)
+        return cls(o.get("s", ""), bytes(o.get("d", b"")),
+                   bool(o.get("c", False)))
+
+
+class IceCandidates:
+    """ICE negotiation blob (ref: default_types.h:142-180)."""
+
+    TYPE = ICE_CANDIDATES
+
+    def __init__(self, msg_id: int = 0, ice_data: bytes = b""):
+        self.id = msg_id
+        self.ice_data = bytes(ice_data)
+
+    def pack(self) -> bytes:
+        return msgpack.packb({"id": self.id, "ice": self.ice_data})
+
+    @classmethod
+    def unpack(cls, blob: bytes) -> "IceCandidates":
+        o = msgpack.unpackb(blob, raw=False, strict_map_key=False)
+        return cls(o.get("id", 0), bytes(o.get("ice", b"")))
+
 DEFAULT_TYPES = [
     USER_DATA,
     DhtMessage.TYPE,

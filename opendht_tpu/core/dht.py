@@ -49,7 +49,7 @@ from .node_cache import NodeCache
 from .routing_table import Bucket, RoutingTable
 from .scheduler import Scheduler
 from .storage import LocalListener, RemoteListener, Storage
-from .value import (Field, FieldValueIndex, Filter, Query, Select, Value,
+from .value import (Field, FieldValueIndex, Filter, Query, Select, Value, Where,
                     ValueType, USER_DATA, f_chain_and)
 
 LISTEN_NODES = 4  # ref: include/opendht/dht.h:330
@@ -139,7 +139,8 @@ class SearchNode:
     """Per-node state inside a search (ref: src/dht.cpp:244-461)."""
 
     __slots__ = ("node", "token", "last_get_reply", "candidate",
-                 "get_status", "listen_status", "acked", "probe_query")
+                 "get_status", "listen_status", "acked", "probe_query",
+                 "pagination_queries")
 
     def __init__(self, node: Node):
         self.node = node
@@ -153,6 +154,16 @@ class SearchNode:
         # vid -> (Request | None, refresh_time)
         self.acked: Dict[int, Tuple[Optional[Request], float]] = {}
         self.probe_query: Optional[Query] = None
+        # qkey(original get query) -> [qkey of pagination sub-queries]
+        # (ref: SearchNode::pagination_queries src/dht.cpp:258)
+        self.pagination_queries: Dict[bytes, List[bytes]] = {}
+
+    def has_started_pagination(self, qk: bytes) -> bool:
+        """ref: SearchNode::hasStartedPagination src/dht.cpp:333-342."""
+        pqs = self.pagination_queries.get(qk)
+        if not pqs:
+            return False
+        return any(sq in self.get_status for sq in pqs)
 
     def is_synced(self, now: float) -> bool:
         return (not self.node.is_expired() and bool(self.token)
@@ -186,11 +197,19 @@ class SearchNode:
                     completed_sq = True
         return ((not pending
                  and now > self.last_get_reply + NODE_EXPIRE_TIME)
-                or not (completed_sq or pending_sq))
+                or not (self.has_started_pagination(qkey(query))
+                        or completed_sq or pending_sq))
 
     def is_done(self, get: Get) -> bool:
-        """ref: SearchNode::isDone src/dht.cpp:356-369"""
-        entry = self.get_status.get(qkey(get.query))
+        """ref: SearchNode::isDone src/dht.cpp:356-369 — a paginated
+        get is done when none of its sub-requests are pending."""
+        qk = qkey(get.query)
+        if self.has_started_pagination(qk):
+            return not any(
+                self.get_status[sq][1].pending()
+                for sq in self.pagination_queries.get(qk, ())
+                if sq in self.get_status)
+        entry = self.get_status.get(qk)
         return entry is not None and not entry[1].pending()
 
     def is_announced(self, vid: int, now: float) -> bool:
@@ -1111,6 +1130,13 @@ class Dht:
             else:
                 if n is None:
                     continue
+                # A get without an explicit selection is paginated:
+                # SELECT id first, then one sub-get per value id
+                # (ref: Dht::paginate src/dht.cpp:1117-1168, dispatch
+                # :1216-1227).
+                if query is None or not query.select.fields:
+                    self._paginate(sr, query, n)
+                    return n
                 k = qkey(query)
                 n.get_status[k] = (query, self.engine.send_get_values(
                     n.node, sr.id, query if (query and query) else None,
@@ -1129,6 +1155,47 @@ class Dht:
         if self.running6:
             w |= WANT6
         return w
+
+    def _paginate(self, sr: Search, query: Optional[Query],
+                  sn: SearchNode) -> None:
+        """Split a select-less get per value id: a ``SELECT id`` probe,
+        then one ``GET WHERE id=<vid>`` per discovered id — so huge
+        storages stream incrementally (ref: Dht::paginate
+        src/dht.cpp:1117-1168)."""
+        select_q = Query(Select().field(Field.Id),
+                         query.where if query is not None else None)
+        qk = qkey(query)
+
+        def on_select_done(req: Request, answer: RequestAnswer) -> None:
+            ssr = sr
+            nn = ssr.get_node(req.node)
+            if nn is None:
+                return
+            if not answer.fields:
+                # Node answered without field projection: fall back to
+                # treating this as the whole get's answer.
+                self._search_node_get_done(req, answer, ssr, query)
+                return
+            for fvi in answer.fields:
+                vid = fvi.index.get(Field.Id)
+                if not vid:
+                    continue
+                q_vid = Query(Select(), Where().id(int(vid)))
+                kq = qkey(q_vid)
+                nn.pagination_queries.setdefault(qk, []).append(kq)
+                nn.get_status[kq] = (q_vid, self.engine.send_get_values(
+                    req.node, ssr.id, q_vid, 0,
+                    on_done=lambda r, a, q=query:
+                        self._search_node_get_done(r, a, ssr, q),
+                    on_expired=lambda r, over, q=q_vid:
+                        self._search_node_get_expired(r, over, ssr, q)))
+
+        sn.pagination_queries.setdefault(qk, []).append(qkey(select_q))
+        sn.get_status[qkey(select_q)] = (select_q, self.engine.send_get_values(
+            sn.node, sr.id, select_q, 0,
+            on_done=on_select_done,
+            on_expired=lambda r, over, q=select_q:
+                self._search_node_get_expired(r, over, sr, q)))
 
     def _search_node_get_done(self, req: Request, answer: RequestAnswer,
                               sr: Search, query: Optional[Query]) -> None:

@@ -148,9 +148,38 @@ def listen_churn(n_nodes: int = 16, seed: int = 4) -> Dict[str, float]:
     return {"sent": sent, "received": len(set(seen))}
 
 
+def local_putget(n_keys: int = 1000, seed: int = 5) -> Dict[str, float]:
+    """Single-node 1k-key put/get loop — BASELINE.json config 1 (the
+    CPU floor: pure core + storage path, no network)."""
+    import time as _t
+    net = DhtNetwork(1, seed=seed)
+    node = net.nodes[0]
+    keys = [InfoHash.get(f"k{i}") for i in range(n_keys)]
+    t0 = _t.monotonic()
+    for i, h in enumerate(keys):
+        done = {}
+        node.put(h, Value(f"v{i}".encode()),
+                 lambda ok, nodes: done.update(ok=True))
+        net.run(0.01)
+    put_dt = _t.monotonic() - t0
+    t0 = _t.monotonic()
+    hits = 0
+    for i, h in enumerate(keys):
+        vals = node.get_local(h)
+        if vals and vals[0].data == f"v{i}".encode():
+            hits += 1
+    get_dt = _t.monotonic() - t0
+    return {
+        "keys": n_keys, "hit_rate": hits / n_keys,
+        "puts_per_sec": round(n_keys / put_dt, 1),
+        "local_gets_per_sec": round(n_keys / get_dt, 1),
+    }
+
+
 SCENARIOS = {
     "gets": performance_gets,
     "delete": persistence_delete,
     "replace": persistence_replace,
     "listen": listen_churn,
+    "local": local_putget,
 }

@@ -47,7 +47,7 @@ from ..ops.xor_metric import (
     closest_nodes_batched,
     common_bits,
     lex_searchsorted,
-    merge_shortlists,
+    merge_shortlists_dist,
 )
 
 UINT32_MAX = 0xFFFFFFFF
@@ -83,10 +83,15 @@ class Swarm(NamedTuple):
 
 
 class LookupState(NamedTuple):
-    """Lock-step batched lookup state (all ``[L, ...]``)."""
+    """Lock-step batched lookup state (all ``[L, ...]``).
+
+    The shortlist carries XOR *distances* rather than ids: since
+    ``dist = id ^ target`` is a bijection per lookup, ids are
+    recoverable on demand and never ride through the sort hot path.
+    """
     targets: jax.Array  # [L,5]
     idx: jax.Array      # [L,S] shortlist node indices, sorted by dist
-    ids: jax.Array      # [L,S,5]
+    dist: jax.Array     # [L,S,5] xor distance to target (sentinel=all-1)
     queried: jax.Array  # [L,S] bool
     done: jax.Array     # [L] bool
     hops: jax.Array     # [L] int32 — solicitation rounds until sync
@@ -269,12 +274,11 @@ def init_impl(ids: jax.Array, respond, cfg: SwarmConfig,
     cand_idx = jnp.concatenate(
         [resp, jnp.full((l, max(0, s - resp.shape[1])), -1, jnp.int32)],
         axis=1) if resp.shape[1] < s else resp
-    cand_ids = ids[jnp.clip(cand_idx, 0, cfg.n_nodes - 1)]
-    f_idx, f_ids, f_q = merge_shortlists(
-        targets, cand_ids, cand_idx,
-        jnp.zeros_like(cand_idx, bool), keep=s)
+    cand_dist = _resp_dist(ids, cfg, targets, cand_idx)
+    f_idx, f_dist, f_q = merge_shortlists_dist(
+        cand_dist, cand_idx, jnp.zeros_like(cand_idx, bool), keep=s)
     return LookupState(
-        targets=targets, idx=f_idx, ids=f_ids, queried=f_q,
+        targets=targets, idx=f_idx, dist=f_dist, queried=f_q,
         done=jnp.zeros((l,), bool), hops=jnp.zeros((l,), jnp.int32))
 
 
@@ -297,12 +301,16 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
 
     resp = respond(st.targets, sel)                             # [L,A*2K]
     cand_idx = jnp.concatenate([idx, resp], axis=1)
-    cand_ids = jnp.concatenate(
-        [st.ids, ids[jnp.clip(resp, 0, cfg.n_nodes - 1)]], axis=1)
+    # Evicted frontier slots must not keep their old (now invalid)
+    # distance keys.
+    fr_dist = jnp.where(evict[..., None], jnp.uint32(UINT32_MAX),
+                        st.dist)
+    cand_dist = jnp.concatenate(
+        [fr_dist, _resp_dist(ids, cfg, st.targets, resp)], axis=1)
     cand_q = jnp.concatenate(
         [queried, jnp.zeros_like(resp, bool)], axis=1)
-    f_idx, f_ids, f_q = merge_shortlists(
-        st.targets, cand_ids, cand_idx, cand_q, keep=cfg.search_width)
+    f_idx, f_dist, f_q = merge_shortlists_dist(
+        cand_dist, cand_idx, cand_q, keep=cfg.search_width)
 
     active = ~st.done & jnp.any(sel >= 0, axis=1)
     done = st.done | _sync_done(f_idx, f_q, cfg) | ~jnp.any(
@@ -310,14 +318,40 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     return LookupState(
         targets=st.targets,
         idx=jnp.where(st.done[:, None], st.idx, f_idx),
-        ids=jnp.where(st.done[:, None, None], st.ids, f_ids),
+        dist=jnp.where(st.done[:, None, None], st.dist, f_dist),
         queried=jnp.where(st.done[:, None], st.queried, f_q),
         done=done,
         hops=st.hops + active.astype(jnp.int32))
 
 
+def _resp_dist(ids: jax.Array, cfg: SwarmConfig, targets: jax.Array,
+               cand_idx: jax.Array) -> jax.Array:
+    """XOR distance limbs for candidate indices (sentinel where -1)."""
+    cand_ids = ids[jnp.clip(cand_idx, 0, cfg.n_nodes - 1)]
+    d = jnp.bitwise_xor(cand_ids, targets[:, None, :])
+    return jnp.where((cand_idx < 0)[..., None], jnp.uint32(UINT32_MAX), d)
+
+
 def _local_respond(swarm: Swarm, cfg: SwarmConfig):
     return lambda tg, nid: _respond(swarm, cfg, tg, nid)
+
+
+@jax.jit
+def _sample_origins(key: jax.Array, alive: jax.Array,
+                    l: int) -> jax.Array:
+    """Uniform random *alive* origin per lookup.
+
+    Two-draw rejection with a first-alive fallback — O(L) memory.
+    (A categorical over the alive mask materializes an [L, N] gumbel
+    plane when not fused: 372 GB at L=100k, N=1M.)
+    """
+    n = alive.shape[0]
+    c1 = jax.random.randint(key, (l,), 0, n, jnp.int32)
+    c2 = jax.random.randint(jax.random.fold_in(key, 1), (l,), 0, n,
+                            jnp.int32)
+    first_alive = jnp.argmax(alive).astype(jnp.int32)
+    return jnp.where(alive[c1], c1,
+                     jnp.where(alive[c2], c2, first_alive))
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -345,9 +379,7 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     """
     l = targets.shape[0]
     # Origins are drawn from *alive* nodes: the issuing node exists.
-    logits = jnp.where(swarm.alive, 0.0, -jnp.inf)
-    origins = jax.random.categorical(
-        key, logits, shape=(l,)).astype(jnp.int32)
+    origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
 
     def cond(st):
@@ -357,6 +389,72 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     found = jnp.where(st.queried[:, :cfg.quorum],
                       st.idx[:, :cfg.quorum], -1)
     return LookupResult(found=found, hops=st.hops, done=st.done)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps"))
+def lookup_steps(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+                 n_steps: int) -> LookupState:
+    """Run a fixed number of lock-step rounds (no early exit)."""
+    return jax.lax.fori_loop(
+        0, n_steps, lambda _, s: lookup_step(swarm, cfg, s), st)
+
+
+def _finalize(st: LookupState, cfg: SwarmConfig) -> jax.Array:
+    return jnp.where(st.queried[:, :cfg.quorum], st.idx[:, :cfg.quorum],
+                     -1)
+
+
+def lookup_compact(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
+                   key: jax.Array, chunk: int = 4) -> LookupResult:
+    """Batched lookups with host-side active-set compaction.
+
+    Same result as :func:`lookup`, but every ``chunk`` rounds the
+    finished lookups are retired and the remainder re-packed into the
+    next power-of-two batch, so the long tail (a few slow lookups) no
+    longer pays full-batch cost per round.  Compile cache: one program
+    per power-of-two batch size.
+    """
+    import numpy as np
+
+    l = targets.shape[0]
+    origins = _sample_origins(key, swarm.alive, l)
+    st = lookup_init(swarm, cfg, targets, origins)
+
+    found = np.full((l, cfg.quorum), -1, np.int32)
+    hops = np.zeros((l,), np.int32)
+    done_out = np.zeros((l,), bool)
+    idx_map = np.arange(l)
+    total = 0
+    while total < cfg.max_steps and len(idx_map):
+        n = min(chunk, cfg.max_steps - total)
+        st = lookup_steps(swarm, cfg, st, n)
+        total += n
+        done = np.asarray(st.done)
+        live = idx_map >= 0
+        finished = (done | (total >= cfg.max_steps)) & live
+        if finished.any():
+            rows = idx_map[finished]
+            f = np.asarray(_finalize(st, cfg))
+            found[rows] = f[finished]
+            hops[rows] = np.asarray(st.hops)[finished]
+            done_out[rows] = done[finished]
+        active = live & ~done & (total < cfg.max_steps)
+        n_act = int(active.sum())
+        if n_act == 0:
+            break
+        # Re-pack to the next power-of-two batch ≥ n_act (pad rows are
+        # duplicates of row 0 whose results are discarded via idx_map).
+        cap = max(256, 1 << (n_act - 1).bit_length())
+        if cap >= len(idx_map):
+            continue
+        sel = np.nonzero(active)[0]
+        pad = np.full(cap - n_act, sel[0], dtype=sel.dtype)
+        take = jnp.asarray(np.concatenate([sel, pad]))
+        st = jax.tree_util.tree_map(lambda a: a[take], st)
+        idx_map = np.concatenate(
+            [idx_map[sel], np.full(cap - n_act, -1, idx_map.dtype)])
+    return LookupResult(found=jnp.asarray(found), hops=jnp.asarray(hops),
+                        done=jnp.asarray(done_out))
 
 
 @partial(jax.jit, static_argnames=("cfg", "k"))

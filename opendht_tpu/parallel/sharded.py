@@ -128,9 +128,8 @@ def _sharded_body(cfg: SwarmConfig, n_shards: int, ids, tables_local,
     me = jax.lax.axis_index(AXIS)
     key = jax.random.fold_in(key, me)
 
-    logits = jnp.where(alive, 0.0, -jnp.inf)
-    origins = jax.random.categorical(key, logits, shape=(ll,)).astype(
-        jnp.int32)
+    from ..models.swarm import _sample_origins
+    origins = _sample_origins(key, alive, ll)
 
     def respond(tg, nid):
         return _route_respond(tables_local, ids, alive, tg, nid, cfg,

@@ -407,3 +407,36 @@ class DhtRunner:
 
     def export_values(self):
         return self.dht.export_values()
+
+    # ------------------------------------------------------------------ #
+    # state persistence (checkpoint/resume; the reference leaves blob    #
+    # storage to callers — ref: exportNodes/importValues                 #
+    # src/dht.cpp:3029-3121)                                             #
+    # ------------------------------------------------------------------ #
+
+    def save_state(self, path: str) -> None:
+        """Persist good nodes + stored values to a file."""
+        import msgpack
+
+        from .nodeset import NodeSet
+        ns = NodeSet(self.dht.export_nodes())
+        blob = msgpack.packb({
+            "nodes": ns.serialize(),
+            "values": self.dht.export_values(),
+        })
+        with open(path, "wb") as f:
+            f.write(blob)
+
+    def load_state(self, path: str) -> int:
+        """Re-insert persisted nodes (no pings) and import values.
+        Returns the number of bootstrap nodes restored."""
+        import msgpack
+
+        from .nodeset import NodeSet
+        with open(path, "rb") as f:
+            obj = msgpack.unpackb(f.read(), raw=False)
+        ns = NodeSet.deserialize(obj["nodes"])
+        self.bootstrap_nodes(list(ns))
+        vals = [tuple(v) for v in obj.get("values", [])]
+        self._post(lambda: self.dht.import_values(vals), prio=True)
+        return len(ns)

@@ -1,0 +1,103 @@
+"""HTTP → DHT REST gateway (ref: python/tools/http_server.py, the
+Twisted-based gateway in the reference harness).
+
+    GET  /<key>          -> JSON list of values stored at the key
+    POST /<key>  (body)  -> put the body as a value; 200 on announce
+
+Keys are free-form strings (SHA-1 hashed) or 40-char hex infohashes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..core.value import Value
+from ..utils.infohash import InfoHash
+from .common import add_common_args, start_node
+
+
+def _h(word: str) -> InfoHash:
+    return InfoHash(word) if len(word) == 40 else InfoHash.get(word)
+
+
+def make_handler(node):
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            key = self.path.strip("/")
+            if not key:
+                self._reply(400, {"error": "missing key"})
+                return
+            done = threading.Event()
+            vals = []
+
+            def gcb(vs):
+                vals.extend(vs)
+                return True
+
+            node.get(_h(key), gcb, lambda ok, nodes: done.set())
+            done.wait(timeout=30)
+            self._reply(200, [
+                {"id": f"{v.id:016x}", "type": v.type,
+                 "data": base64.b64encode(v.data).decode(),
+                 "signed": v.is_signed(), "encrypted": v.is_encrypted()}
+                for v in vals])
+
+        def do_POST(self):
+            key = self.path.strip("/")
+            length = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(length)
+            if not key or not data:
+                self._reply(400, {"error": "missing key or body"})
+                return
+            done = threading.Event()
+            res = {}
+
+            def dcb(ok, nodes):
+                res["ok"] = ok
+                done.set()
+
+            node.put(_h(key), Value(data), dcb)
+            done.wait(timeout=30)
+            self._reply(200 if res.get("ok") else 502,
+                        {"ok": res.get("ok", False)})
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="http_gateway", description=__doc__)
+    add_common_args(ap)
+    ap.add_argument("--http-port", type=int, default=8080)
+    args = ap.parse_args(argv)
+    node = start_node(args)
+    srv = ThreadingHTTPServer(("127.0.0.1", args.http_port),
+                              make_handler(node))
+    print(f"HTTP gateway on 127.0.0.1:{args.http_port} "
+          f"(DHT port {node.get_bound_port()})")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    node.shutdown()
+    node.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

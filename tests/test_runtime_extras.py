@@ -1,0 +1,63 @@
+"""NodeSet, state persistence, default-type wrappers."""
+
+import os
+import tempfile
+import time
+
+from opendht_tpu import DhtRunner, InfoHash, NodeSet, SockAddr, Value
+from opendht_tpu.core.default_types import (
+    IceCandidates, ImMessage, TrustRequest,
+)
+
+
+def test_nodeset_roundtrip_and_dedup():
+    ns = NodeSet()
+    a = (InfoHash.get("a"), SockAddr("10.0.0.1", 4222))
+    assert ns.insert(*a)
+    assert not ns.insert(*a)
+    ns.insert(InfoHash.get("b"), SockAddr("10.0.0.2", 4223))
+    ns2 = NodeSet.deserialize(ns.serialize())
+    assert len(ns2) == 2
+    assert a in ns2
+    assert ns2.first()[1].host == "10.0.0.1"
+    assert ns2.last()[1].port == 4223
+
+
+def test_default_type_wrappers_roundtrip():
+    t = TrustRequest.unpack(TrustRequest("svc", b"xx", True).pack())
+    assert (t.service, t.payload, t.confirm) == ("svc", b"xx", True)
+    i = IceCandidates.unpack(IceCandidates(7, b"cand").pack())
+    assert (i.id, i.ice_data) == (7, b"cand")
+    m = ImMessage.unpack(ImMessage(1, "hi", 99).pack())
+    assert (m.id, m.message, m.date) == (1, "hi", 99)
+
+
+def test_runner_save_load_state():
+    a, b = DhtRunner(), DhtRunner()
+    a.run(port=0, bind4="127.0.0.1")
+    b.run(port=0, bind4="127.0.0.1")
+    b.bootstrap("127.0.0.1", a.get_bound_port())
+    end = time.monotonic() + 15
+    while time.monotonic() < end and b.get_nodes_stats()[0] == 0:
+        time.sleep(0.05)
+    assert b.get_nodes_stats()[0] > 0
+
+    h = InfoHash.get("persisted")
+    b.put_future(h, Value(b"saved")).result(timeout=15)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "state.mp")
+        b.save_state(path)
+        a.join()
+        b.join()
+
+        c = DhtRunner()
+        c.run(port=0, bind4="127.0.0.1")
+        n = c.load_state(path)
+        assert n >= 1
+        end = time.monotonic() + 10
+        while time.monotonic() < end and not c.dht.get_local(h):
+            time.sleep(0.05)
+        vals = c.dht.get_local(h)
+        assert vals and vals[0].data == b"saved"
+        c.join()
