@@ -336,7 +336,7 @@ def _local_respond(swarm: Swarm, cfg: SwarmConfig):
     return lambda tg, nid: _respond(swarm, cfg, tg, nid)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("l",))
 def _sample_origins(key: jax.Array, alive: jax.Array,
                     l: int) -> jax.Array:
     """Uniform random *alive* origin per lookup.
