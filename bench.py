@@ -35,9 +35,14 @@ def main():
     ap.add_argument("--lookups", type=int, default=100_000)
     ap.add_argument("--repeat", type=int, default=3)
     ap.add_argument("--recall-sample", type=int, default=512)
+    ap.add_argument("--mode", choices=("lookups", "putget"),
+                    default="lookups")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture an XLA profiler trace of one timed run")
     args = ap.parse_args()
+
+    if args.mode == "putget":
+        return putget_main(args)
 
     from opendht_tpu.models.swarm import (
         SwarmConfig, build_swarm, lookup_compact, true_closest,
@@ -92,6 +97,63 @@ def main():
         "median_hops": float(np.median(hops)),
         "done_frac": float(np.asarray(res.done).mean()),
         "recall_at_8": round(recall, 4),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+
+
+def putget_main(args):
+    """Full DHT round-trip: announce P values, then get them all.
+
+    Exercises storage (onAnnounce/onGetValues scatter-gather), not just
+    routing — the workload of the reference's persistence scenarios
+    (python/tools/dht/tests.py:439-827).
+    """
+    from opendht_tpu.models.storage import (
+        StoreConfig, announce, empty_store, get_values,
+    )
+    from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+
+    cfg = SwarmConfig.for_nodes(args.nodes)
+    scfg = StoreConfig(slots=16, listen_slots=4,
+                       max_listeners=1 << 10)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    jax.block_until_ready(swarm.tables)
+    p = args.lookups
+    keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+
+    def roundtrip(seed):
+        store = empty_store(cfg.n_nodes, scfg)
+        store, rep = announce(swarm, cfg, store, scfg, keys, vals, seqs,
+                              0, jax.random.PRNGKey(seed))
+        res = get_values(swarm, cfg, store, scfg, keys,
+                         jax.random.PRNGKey(seed + 1))
+        return rep, res
+
+    rep, res = roundtrip(2)  # warmup/compile
+    jax.block_until_ready(res.hit)
+
+    times = []
+    for r in range(args.repeat):
+        t0 = time.perf_counter()
+        rep, res = roundtrip(10 + 2 * r)
+        jax.block_until_ready(res.hit)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+
+    out = {
+        "metric": "swarm_putget_roundtrips_per_sec",
+        "value": round(p / dt, 1),
+        "unit": "put+get/s",
+        "vs_baseline": round(p / dt / REFERENCE_LOOKUPS_PER_SEC, 2),
+        "n_nodes": args.nodes,
+        "n_puts": p,
+        "wall_s": round(dt, 4),
+        "hit_rate": float(np.asarray(res.hit).mean()),
+        "mean_replicas": float(np.asarray(rep.replicas).mean()),
+        "median_hops": float(np.median(np.asarray(res.hops))),
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(out))

@@ -1,0 +1,423 @@
+"""Device-resident value storage for the TPU swarm engine.
+
+Round 1's engine simulated *routing only* (``onFindNode``).  This module
+adds the half that makes it a DHT: every simulated node carries a small
+value store and a listener table as packed tensors, and the reference's
+storage RPCs become batched scatters/gathers:
+
+* ``announce``  — vectorized ``Dht::onAnnounce``
+  (/root/reference/src/dht.cpp:3333-3399): a batch of puts runs the
+  lock-step lookup to find each key's ``quorum`` closest nodes, then
+  inserts (key, value, seq) into those nodes' stores with the
+  edit-policy seq check (monotonically increasing sequence numbers for
+  an existing key, /root/reference/src/securedht.cpp:103-118) and a
+  bounded per-node budget (the 64 MB / value-count caps of
+  ``Dht::storageStore``, /root/reference/src/dht.cpp:2227-2258, scaled
+  to ``slots`` values per node).
+* ``get_values`` — vectorized ``Dht::onGetValues``
+  (/root/reference/src/dht.cpp:3202-3225): a batch of gets runs the
+  lookup, then probes the stores of the closest queried nodes and
+  returns the freshest matching value (highest seq, the reference's
+  refresh-wins semantics).
+* ``listen_at`` / listener notification — vectorized
+  ``Dht::storageAddListener`` + ``storageChanged``
+  (/root/reference/src/dht.cpp:2186-2225,2299-2322): listener
+  registrations live in a per-node table; every accepted announce
+  matches against the target node's listeners and flips their
+  "notified" bits (the ``tellListener`` push).
+* ``expire`` — per-value TTL sweep (``Storage::expire``,
+  /root/reference/src/dht.cpp:2361-2381).
+* ``republish_from`` — per-node value maintenance: chosen nodes
+  re-announce everything they store, the sim equivalent of
+  ``Dht::dataPersistence``/``maintainStorage``
+  (/root/reference/src/dht.cpp:2887-2947) that keeps values alive
+  under churn.
+
+Storage deviates from the reference in one documented way: when a
+node's store is full, the ring cursor overwrites the oldest slot
+instead of rejecting the new value — under steady TTL expiry the two
+behaviours converge, and the ring keeps every shape static.
+
+All state is a pytree of ``[N, slots]``-shaped arrays, so it shards
+over the node axis exactly like the routing tables.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.xor_metric import N_LIMBS
+from .swarm import LookupResult, Swarm, SwarmConfig, lookup
+
+INT32_MAX = 0x7FFFFFFF
+
+
+def _pad1(a: jax.Array) -> jax.Array:
+    """Append one trash row: masked scatter rows are routed there,
+    because duplicate-index ``.set`` order is unspecified in XLA and
+    inactive rows must never touch live cells."""
+    return jnp.concatenate([a, jnp.zeros((1,) + a.shape[1:], a.dtype)],
+                           axis=0)
+
+
+def _mask_dead(swarm: Swarm, cfg: SwarmConfig,
+               req_node: jax.Array) -> jax.Array:
+    """-1 out requests aimed at dead or invalid nodes (dead replicas
+    never ack — the reference's expired announce targets)."""
+    return jnp.where(
+        (req_node >= 0)
+        & swarm.alive[jnp.clip(req_node, 0, cfg.n_nodes - 1)],
+        req_node, -1)
+
+
+class StoreConfig(NamedTuple):
+    """Static storage geometry (jit cache key).
+
+    ``slots`` scales the reference's per-node budget (≤1024 values/hash,
+    64 MB total, callbacks.h:72 / dht.h:333-339) down to simulation
+    size; ``ttl`` is in abstract sim-time units (0 disables expiry),
+    standing in for the per-ValueType expiration
+    (/root/reference/include/opendht/value.h:75-106).
+    """
+    slots: int = 16
+    listen_slots: int = 4
+    ttl: int = 0
+    max_listeners: int = 1 << 16
+
+
+class SwarmStore(NamedTuple):
+    """Per-node value store + listener table (a pytree of arrays)."""
+    keys: jax.Array      # [N,S,5] uint32 — stored key hashes
+    vals: jax.Array      # [N,S] uint32   — value tokens
+    seqs: jax.Array      # [N,S] uint32   — sequence numbers
+    created: jax.Array   # [N,S] uint32   — sim-time of storage
+    used: jax.Array      # [N,S] bool
+    cursor: jax.Array    # [N] uint32     — ring write position
+    lkeys: jax.Array     # [N,LS,5] uint32 — listened-for keys
+    lids: jax.Array      # [N,LS] int32    — listener registration id, -1
+    lcursor: jax.Array   # [N] uint32
+    notified: jax.Array  # [max_listeners] bool — listener got a push
+
+
+class AnnounceReport(NamedTuple):
+    replicas: jax.Array  # [P] int32 — copies stored per put
+    hops: jax.Array      # [P] — lookup rounds
+    done: jax.Array      # [P] bool — lookup converged
+
+
+class GetResult(NamedTuple):
+    hit: jax.Array   # [P] bool — value retrieved
+    val: jax.Array   # [P] uint32 — freshest value token (0 if miss)
+    seq: jax.Array   # [P] uint32
+    hops: jax.Array  # [P]
+    done: jax.Array  # [P]
+
+
+@partial(jax.jit, static_argnames=("n_nodes", "scfg"))
+def empty_store(n_nodes: int, scfg: StoreConfig) -> SwarmStore:
+    n, s, ls = n_nodes, scfg.slots, scfg.listen_slots
+    return SwarmStore(
+        keys=jnp.zeros((n, s, N_LIMBS), jnp.uint32),
+        vals=jnp.zeros((n, s), jnp.uint32),
+        seqs=jnp.zeros((n, s), jnp.uint32),
+        created=jnp.zeros((n, s), jnp.uint32),
+        used=jnp.zeros((n, s), bool),
+        cursor=jnp.zeros((n,), jnp.uint32),
+        lkeys=jnp.zeros((n, ls, N_LIMBS), jnp.uint32),
+        lids=jnp.full((n, ls), -1, jnp.int32),
+        lcursor=jnp.zeros((n,), jnp.uint32),
+        notified=jnp.zeros((scfg.max_listeners,), bool),
+    )
+
+
+# ---------------------------------------------------------------------------
+# core vectorized insert (the onAnnounce storage path)
+# ---------------------------------------------------------------------------
+
+def _segment_rank(sorted_node: jax.Array, flag: jax.Array) -> jax.Array:
+    """Rank of each flagged row within its node segment.
+
+    ``sorted_node`` ascending; ``flag`` marks rows that consume a slot.
+    Returns, per row, the number of flagged rows strictly before it in
+    the same segment.
+    """
+    before = jnp.cumsum(flag.astype(jnp.int32)) - flag.astype(jnp.int32)
+    first = jnp.searchsorted(sorted_node, sorted_node, side="left")
+    return before - before[first]
+
+
+@partial(jax.jit, static_argnames=("scfg",))
+def _store_insert(store: SwarmStore, scfg: StoreConfig,
+                  req_node: jax.Array, req_key: jax.Array,
+                  req_val: jax.Array, req_seq: jax.Array,
+                  req_put: jax.Array, now: jax.Array
+                  ) -> Tuple[SwarmStore, jax.Array]:
+    """Insert a flat batch of (node, key, val, seq) storage requests.
+
+    ``req_node [M]`` (-1 = skip), ``req_key [M,5]``, ``req_val [M]``,
+    ``req_seq [M]``, ``req_put [M]`` (originating put row).  Returns
+    the new store and accepted-replica counts scattered by ``req_put``
+    into a length-M vector (callers slice the first P rows).
+
+    Semantics per request, mirroring ``Dht::storageStore`` +
+    ``secureType`` edit policy:
+    * key already stored on the node → overwrite iff ``seq >=`` stored
+      seq (refresh/edit), else reject;
+    * new key → ring-slot insert (oldest evicted when full), at most
+      ``slots`` new keys per node per batch (excess dropped — the
+      budget-full drop).
+    """
+    s = scfg.slots
+    m = req_node.shape[0]
+    valid = req_node >= 0
+
+    # --- sort requests by (node, key, seq) so per-node work is contiguous
+    node_sk = jnp.where(valid, req_node, INT32_MAX)
+    sort_ops = (node_sk,) + tuple(req_key[:, i] for i in range(N_LIMBS)) \
+        + (req_seq, req_val, req_put, req_node)
+    out = jax.lax.sort(sort_ops, dimension=0, num_keys=N_LIMBS + 2,
+                       is_stable=True)
+    s_node_sk = out[0]
+    s_key = jnp.stack(out[1:1 + N_LIMBS], axis=-1)
+    s_seq, s_val, s_put, s_node = out[1 + N_LIMBS:5 + N_LIMBS]
+    s_valid = s_node >= 0
+
+    # --- in-batch dedup: same (node, key) → keep the last (max seq) row
+    nxt_same = jnp.zeros((m,), bool).at[:-1].set(
+        (s_node_sk[:-1] == s_node_sk[1:])
+        & jnp.all(s_key[:-1] == s_key[1:], axis=-1))
+    live = s_valid & ~nxt_same
+
+    # --- match against existing slots on the target node
+    n_safe = jnp.clip(s_node, 0, store.keys.shape[0] - 1)
+    slot_keys = store.keys[n_safe]                        # [M,S,5]
+    slot_used = store.used[n_safe]                        # [M,S]
+    km = slot_used & jnp.all(slot_keys == s_key[:, None, :], axis=-1)
+    has_match = jnp.any(km, axis=-1)
+    mslot = jnp.argmax(km, axis=-1).astype(jnp.int32)     # first match
+
+    n_nodes = store.keys.shape[0]
+
+    # --- update path (edit policy: seq must not decrease)
+    cur_seq = store.seqs[n_safe, mslot]
+    upd = live & has_match & (s_seq >= cur_seq)
+    un, us = jnp.where(upd, s_node, n_nodes), mslot
+    vals = _pad1(store.vals).at[un, us].set(s_val)
+    seqs = _pad1(store.seqs).at[un, us].set(s_seq)
+    created = _pad1(store.created).at[un, us].set(now)
+
+    # --- new-key path: ring-slot allocation, ≤ slots per node per batch
+    new = live & ~has_match
+    rank = _segment_rank(s_node_sk, new)
+    slot = ((store.cursor[n_safe] + rank.astype(jnp.uint32))
+            % jnp.uint32(s)).astype(jnp.int32)
+    # A ring slot may coincide with a slot an *update in this same
+    # batch* just refreshed; overwriting it would silently destroy an
+    # accepted value.  Drop the new key instead — the reference's
+    # reject-when-full (``storageStore`` returning false,
+    # /root/reference/src/dht.cpp:2227-2258).
+    upd_map = _pad1(jnp.zeros_like(store.used)).at[un, us].set(upd)[:-1]
+    conflict = upd_map[n_safe, slot]
+    accept_new = new & (rank < s) & ~conflict
+    nn = jnp.where(accept_new, s_node, n_nodes)
+    keys = _pad1(store.keys).at[nn, slot].set(s_key)[:-1]
+    vals = vals.at[nn, slot].set(s_val)[:-1]
+    seqs = seqs.at[nn, slot].set(s_seq)[:-1]
+    created = created.at[nn, slot].set(now)[:-1]
+    used = _pad1(store.used).at[nn, slot].set(True)[:-1]
+    n_new = jnp.zeros_like(store.cursor).at[jnp.where(accept_new, s_node, 0)
+                                            ].add(accept_new.astype(jnp.uint32))
+    cursor = store.cursor + n_new
+
+    # --- listener notification (storageChanged → tellListener)
+    accepted = upd | accept_new
+    lk = store.lkeys[n_safe]                              # [M,LS,5]
+    lid = store.lids[n_safe]                              # [M,LS]
+    lmatch = (lid >= 0) & jnp.all(lk == s_key[:, None, :], axis=-1) \
+        & accepted[:, None]
+    lid_safe = jnp.clip(lid, 0, store.notified.shape[0] - 1)
+    notified = store.notified.at[
+        jnp.where(lmatch, lid_safe, 0).reshape(-1)
+    ].max(lmatch.reshape(-1))
+
+    new_store = store._replace(keys=keys, vals=vals, seqs=seqs,
+                               created=created, used=used, cursor=cursor,
+                               notified=notified)
+    # Per-put replica counts.
+    put_safe = jnp.clip(s_put, 0, None)
+    replicas = jnp.zeros((m,), jnp.int32).at[put_safe].add(
+        accepted.astype(jnp.int32))
+    return new_store, replicas
+
+
+# ---------------------------------------------------------------------------
+# public batched DHT ops
+# ---------------------------------------------------------------------------
+
+def _announce_targets(swarm: Swarm, cfg: SwarmConfig, keys: jax.Array,
+                      rng: jax.Array) -> LookupResult:
+    """Lookup phase of a put: find each key's quorum closest nodes
+    (``searchSendAnnounceValue`` announces to the synced search head,
+    /root/reference/src/dht.cpp:1237-1339)."""
+    return lookup(swarm, cfg, keys, rng)
+
+
+@partial(jax.jit, static_argnames=("cfg", "scfg"))
+def _announce_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                     scfg: StoreConfig, res_found: jax.Array,
+                     keys: jax.Array, vals: jax.Array, seqs: jax.Array,
+                     now: jax.Array) -> Tuple[SwarmStore, jax.Array]:
+    p, q = res_found.shape
+    req_node = _mask_dead(swarm, cfg, res_found.reshape(-1))
+    req_key = jnp.repeat(keys, q, axis=0)
+    req_val = jnp.repeat(vals, q, axis=0)
+    req_seq = jnp.repeat(seqs, q, axis=0)
+    req_put = jnp.repeat(jnp.arange(p, dtype=jnp.int32), q, axis=0)
+    store, rep_m = _store_insert(store, scfg, req_node, req_key, req_val,
+                                 req_seq, req_put, now)
+    return store, rep_m[:p]
+
+
+def announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+             scfg: StoreConfig, keys: jax.Array, vals: jax.Array,
+             seqs: jax.Array, now, rng: jax.Array
+             ) -> Tuple[SwarmStore, AnnounceReport]:
+    """Batched put: lookup each key, store at its quorum closest alive
+    nodes.  ``keys [P,5]``, ``vals [P]``, ``seqs [P]``."""
+    res = _announce_targets(swarm, cfg, keys, rng)
+    store, replicas = _announce_insert(
+        swarm, cfg, store, scfg, res.found, keys, vals, seqs,
+        jnp.uint32(now))
+    return store, AnnounceReport(replicas=replicas, hops=res.hops,
+                                 done=res.done)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _get_probe(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+               found: jax.Array, keys: jax.Array
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Probe the stores of each get's closest queried nodes
+    (``onGetValues`` replies, collected by ``onGetValuesDone``,
+    /root/reference/src/dht.cpp:3227-3297).  Freshest seq wins."""
+    n_safe = jnp.clip(found, 0, cfg.n_nodes - 1)
+    ok = (found >= 0) & swarm.alive[n_safe]
+    sk = store.keys[n_safe]                        # [P,Q,S,5]
+    hit = store.used[n_safe] & ok[..., None] \
+        & jnp.all(sk == keys[:, None, None, :], axis=-1)   # [P,Q,S]
+    sseq = jnp.where(hit, store.seqs[n_safe], 0)
+    best_seq = jnp.max(sseq, axis=(1, 2))
+    is_best = hit & (sseq == best_seq[:, None, None])
+    val = jnp.max(jnp.where(is_best, store.vals[n_safe], 0), axis=(1, 2))
+    any_hit = jnp.any(hit, axis=(1, 2))
+    return any_hit, val, best_seq
+
+
+def get_values(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+               scfg: StoreConfig, keys: jax.Array, rng: jax.Array,
+               chunk: int = 32768) -> GetResult:
+    """Batched get: lookup each key, return the freshest stored value
+    among the closest queried nodes.  ``keys [P,5]``."""
+    res = lookup(swarm, cfg, keys, rng)
+    p = keys.shape[0]
+    hits, vals, seqs = [], [], []
+    for lo in range(0, p, chunk):
+        hi = min(lo + chunk, p)
+        h, v, s = _get_probe(swarm, cfg, store, res.found[lo:hi],
+                             keys[lo:hi])
+        hits.append(h), vals.append(v), seqs.append(s)
+    return GetResult(
+        hit=jnp.concatenate(hits), val=jnp.concatenate(vals),
+        seq=jnp.concatenate(seqs), hops=res.hops, done=res.done)
+
+
+@partial(jax.jit, static_argnames=("cfg", "scfg"))
+def _listen_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                   scfg: StoreConfig, found: jax.Array, keys: jax.Array,
+                   reg_ids: jax.Array) -> SwarmStore:
+    ls = scfg.listen_slots
+    p, q = found.shape
+    req_node = _mask_dead(swarm, cfg, found.reshape(-1))
+    req_key = jnp.repeat(keys, q, axis=0)
+    req_id = jnp.repeat(reg_ids, q, axis=0)
+    # Out-of-range registration ids are dropped outright — clipping
+    # would flip some other listener's notified bit at announce time.
+    req_node = jnp.where(
+        (req_id >= 0) & (req_id < scfg.max_listeners), req_node, -1)
+    valid = req_node >= 0
+
+    node_sk = jnp.where(valid, req_node, INT32_MAX)
+    out = jax.lax.sort(
+        (node_sk,) + tuple(req_key[:, i] for i in range(N_LIMBS))
+        + (req_id, req_node),
+        dimension=0, num_keys=1, is_stable=True)
+    s_node_sk = out[0]
+    s_key = jnp.stack(out[1:1 + N_LIMBS], axis=-1)
+    s_id, s_node = out[1 + N_LIMBS], out[2 + N_LIMBS]
+    live = s_node >= 0
+
+    rank = _segment_rank(s_node_sk, live)
+    accept = live & (rank < ls)
+    n_safe = jnp.clip(s_node, 0, cfg.n_nodes - 1)
+    slot = ((store.lcursor[n_safe] + rank.astype(jnp.uint32))
+            % jnp.uint32(ls)).astype(jnp.int32)
+    nn = jnp.where(accept, s_node, cfg.n_nodes)
+    lkeys = _pad1(store.lkeys).at[nn, slot].set(s_key)[:-1]
+    lids = _pad1(store.lids).at[nn, slot].set(s_id)[:-1]
+    n_new = jnp.zeros_like(store.lcursor).at[
+        jnp.where(accept, s_node, 0)].add(accept.astype(jnp.uint32))
+    return store._replace(lkeys=lkeys, lids=lids,
+                          lcursor=store.lcursor + n_new)
+
+
+def listen_at(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+              scfg: StoreConfig, keys: jax.Array, reg_ids: jax.Array,
+              rng: jax.Array) -> Tuple[SwarmStore, LookupResult]:
+    """Batched listen: register listener ``reg_ids [P]`` for ``keys
+    [P,5]`` at each key's quorum closest nodes (``Dht::listenTo`` →
+    ``storageAddListener``).  Subsequent announces of a key flip the
+    ``notified`` bit of its listeners."""
+    res = lookup(swarm, cfg, keys, rng)
+    store = _listen_insert(swarm, cfg, store, scfg, res.found, keys,
+                           reg_ids)
+    return store, res
+
+
+@partial(jax.jit, static_argnames=("scfg",))
+def expire(store: SwarmStore, scfg: StoreConfig, now) -> SwarmStore:
+    """TTL sweep (``Storage::expire``).  No-op when ``ttl == 0``."""
+    if scfg.ttl == 0:
+        return store
+    age = jnp.uint32(now) - store.created
+    return store._replace(used=store.used & (age <= jnp.uint32(scfg.ttl)))
+
+
+def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                   scfg: StoreConfig, node_idx: jax.Array, now,
+                   rng: jax.Array) -> Tuple[SwarmStore, AnnounceReport]:
+    """Chosen nodes re-announce every value they hold — the storage
+    maintenance that restores replication after churn
+    (``Dht::dataPersistence``, /root/reference/src/dht.cpp:2887-2947).
+
+    ``node_idx [M]``: republishing nodes (use alive survivors).  Their
+    ``M*slots`` stored values become one announce batch (unused slots
+    are masked out by announcing to no one via key of an impossible
+    put row — we simply reuse ``announce`` with masked lookups).
+    """
+    s = scfg.slots
+    n_safe = jnp.clip(node_idx, 0, cfg.n_nodes - 1)
+    ok = (node_idx >= 0)[:, None] & swarm.alive[n_safe][:, None] \
+        & store.used[n_safe]                               # [M,S]
+    keys = store.keys[n_safe].reshape(-1, N_LIMBS)
+    vals = store.vals[n_safe].reshape(-1)
+    seqs = store.seqs[n_safe].reshape(-1)
+    okf = ok.reshape(-1)
+    res = lookup(swarm, cfg, keys, rng)
+    found = jnp.where(okf[:, None], res.found, -1)
+    store, replicas = _announce_insert(swarm, cfg, store, scfg, found,
+                                       keys, vals, seqs, jnp.uint32(now))
+    return store, AnnounceReport(replicas=replicas, hops=res.hops,
+                                 done=res.done)

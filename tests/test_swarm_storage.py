@@ -1,0 +1,293 @@
+"""Device-side value storage: put/get/listen/expire/republish.
+
+Covers the vectorized equivalents of the reference storage RPC
+semantics (onAnnounce/onGetValues/onListen, storageChanged,
+Storage::expire, dataPersistence — /root/reference/src/dht.cpp:
+3202-3225, 3333-3399, 2186-2225, 2361-2381, 2887-2947) on the virtual
+CPU mesh sizes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opendht_tpu.models.storage import (
+    StoreConfig,
+    _store_insert,
+    announce,
+    empty_store,
+    expire,
+    get_values,
+    listen_at,
+    republish_from,
+)
+from opendht_tpu.models.swarm import SwarmConfig, build_swarm, churn
+
+
+@pytest.fixture(scope="module")
+def small_swarm():
+    cfg = SwarmConfig.for_nodes(2048)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    return swarm, cfg
+
+
+SCFG = StoreConfig(slots=8, listen_slots=4, max_listeners=1024)
+
+
+def _rand_keys(seed, p):
+    return jax.random.bits(jax.random.PRNGKey(seed), (p, 5), jnp.uint32)
+
+
+class TestStoreInsert:
+    """Unit tests of the raw scatter-insert (storageStore semantics)."""
+
+    def test_basic_insert_and_lookup_shape(self):
+        store = empty_store(64, SCFG)
+        node = jnp.array([3, 5, 3, -1], jnp.int32)
+        key = _rand_keys(1, 4)
+        val = jnp.arange(4, dtype=jnp.uint32) + 100
+        seq = jnp.zeros(4, jnp.uint32)
+        put = jnp.arange(4, dtype=jnp.int32)
+        store, reps = _store_insert(store, SCFG, node, key, val, seq,
+                                    put, jnp.uint32(7))
+        used = np.asarray(store.used)
+        assert used[3].sum() == 2 and used[5].sum() == 1
+        assert used.sum() == 3
+        r = np.asarray(reps)[:4]
+        assert r.tolist() == [1, 1, 1, 0]
+        # Stored key/val round-trip.
+        k3 = np.asarray(store.keys[3])[np.asarray(store.used[3])]
+        assert {tuple(row) for row in k3} == {
+            tuple(np.asarray(key[0])), tuple(np.asarray(key[2]))}
+
+    def test_same_key_update_requires_monotonic_seq(self):
+        """Edit policy: overwrite iff seq >= stored seq
+        (securedht.cpp:103-118)."""
+        store = empty_store(8, SCFG)
+        k = _rand_keys(2, 1)
+        node = jnp.array([1], jnp.int32)
+        put = jnp.zeros(1, jnp.int32)
+
+        def ins(store, val, seq):
+            return _store_insert(store, SCFG, node, k,
+                                 jnp.array([val], jnp.uint32),
+                                 jnp.array([seq], jnp.uint32), put,
+                                 jnp.uint32(0))
+
+        store, r1 = ins(store, 10, 5)
+        store, r2 = ins(store, 11, 6)   # newer seq: accepted
+        store, r3 = ins(store, 12, 4)   # stale seq: rejected
+        assert int(r1[0]) == 1 and int(r2[0]) == 1 and int(r3[0]) == 0
+        assert int(store.used[1].sum()) == 1  # still one slot
+        slot = int(np.argmax(np.asarray(store.used[1])))
+        assert int(store.vals[1, slot]) == 11
+        assert int(store.seqs[1, slot]) == 6
+
+    def test_in_batch_dedup_keeps_highest_seq(self):
+        store = empty_store(8, SCFG)
+        k = jnp.tile(_rand_keys(3, 1), (3, 1))
+        node = jnp.full((3,), 2, jnp.int32)
+        val = jnp.array([7, 8, 9], jnp.uint32)
+        seq = jnp.array([1, 3, 2], jnp.uint32)
+        put = jnp.arange(3, dtype=jnp.int32)
+        store, _ = _store_insert(store, SCFG, node, k, val, seq, put,
+                                 jnp.uint32(0))
+        assert int(store.used[2].sum()) == 1
+        slot = int(np.argmax(np.asarray(store.used[2])))
+        assert int(store.vals[2, slot]) == 8 and int(store.seqs[2, slot]) == 3
+
+    def test_ring_eviction_overwrites_oldest(self):
+        scfg = StoreConfig(slots=4, listen_slots=2, max_listeners=64)
+        store = empty_store(4, scfg)
+        for i in range(6):  # 6 distinct keys through a 4-slot ring
+            store, _ = _store_insert(
+                store, scfg, jnp.array([0], jnp.int32), _rand_keys(10 + i, 1),
+                jnp.array([i], jnp.uint32), jnp.zeros(1, jnp.uint32),
+                jnp.zeros(1, jnp.int32), jnp.uint32(i))
+        assert int(store.used[0].sum()) == 4
+        vals = sorted(np.asarray(store.vals[0]).tolist())
+        assert vals == [2, 3, 4, 5]  # oldest two evicted
+
+    def test_same_batch_refresh_plus_new_key_keeps_refresh(self):
+        """A ring slot colliding with a same-batch accepted refresh must
+        not destroy the refreshed value (the new key is dropped, like
+        storageStore's reject-when-full)."""
+        scfg = StoreConfig(slots=2, listen_slots=2, max_listeners=64)
+        store = empty_store(2, scfg)
+        ka, kb = _rand_keys(30, 1), _rand_keys(31, 1)
+
+        def ins(store, keys, vals, seqs):
+            p = keys.shape[0]
+            return _store_insert(
+                store, scfg, jnp.zeros(p, jnp.int32), keys,
+                jnp.asarray(vals, jnp.uint32),
+                jnp.asarray(seqs, jnp.uint32),
+                jnp.arange(p, dtype=jnp.int32), jnp.uint32(0))
+
+        store, _ = ins(store, jnp.concatenate([ka, kb]), [1, 2], [0, 0])
+        assert int(store.used[0].sum()) == 2  # full, cursor=2
+        # One batch: refresh A (seq 5) + brand-new key C.  C's ring slot
+        # is cursor % 2 = 0 = A's slot.
+        kc = _rand_keys(32, 1)
+        store, reps = ins(store, jnp.concatenate([ka, kc]), [10, 3],
+                          [5, 0])
+        vals = np.asarray(store.vals[0])[np.asarray(store.used[0])]
+        assert 10 in vals.tolist(), "accepted refresh was destroyed"
+        r = np.asarray(reps)[:2]
+        assert r[0] == 1
+
+    def test_listener_reg_id_out_of_range_dropped(self, small_swarm):
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        keys = _rand_keys(33, 2)
+        regs = jnp.array([SCFG.max_listeners + 5, 3], jnp.int32)
+        store, _ = listen_at(swarm, cfg, store, SCFG, keys, regs,
+                             jax.random.PRNGKey(34))
+        store, _ = announce(swarm, cfg, store, SCFG, keys,
+                            jnp.ones(2, jnp.uint32),
+                            jnp.ones(2, jnp.uint32), 0,
+                            jax.random.PRNGKey(35))
+        notified = np.asarray(store.notified)
+        assert bool(notified[3])
+        # The out-of-range id neither wrapped nor hit the last slot.
+        assert not bool(notified[SCFG.max_listeners - 1])
+
+    def test_per_batch_node_overflow_dropped(self):
+        scfg = StoreConfig(slots=4, listen_slots=2, max_listeners=64)
+        store = empty_store(4, scfg)
+        p = 7  # 7 distinct keys to one node in ONE batch, cap 4
+        store, reps = _store_insert(
+            store, scfg, jnp.zeros(p, jnp.int32), _rand_keys(20, p),
+            jnp.arange(p, dtype=jnp.uint32), jnp.zeros(p, jnp.uint32),
+            jnp.arange(p, dtype=jnp.int32), jnp.uint32(0))
+        assert int(store.used[0].sum()) == 4
+        assert int(np.asarray(reps)[:p].sum()) == 4
+
+
+class TestPutGet:
+    def test_put_get_roundtrip(self, small_swarm):
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        p = 256
+        keys = _rand_keys(5, p)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 1
+        seqs = jnp.ones(p, jnp.uint32)
+        store, rep = announce(swarm, cfg, store, SCFG, keys, vals, seqs,
+                              0, jax.random.PRNGKey(6))
+        reps = np.asarray(rep.replicas)
+        assert reps.min() >= cfg.quorum - 2, reps.min()
+
+        res = get_values(swarm, cfg, store, SCFG, keys,
+                         jax.random.PRNGKey(7))
+        hit = np.asarray(res.hit)
+        assert hit.mean() > 0.99, hit.mean()
+        got = np.asarray(res.val)[hit]
+        want = np.asarray(vals)[hit]
+        assert (got == want).all()
+
+    def test_get_missing_key_misses(self, small_swarm):
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        res = get_values(swarm, cfg, store, SCFG, _rand_keys(8, 64),
+                         jax.random.PRNGKey(9))
+        assert not bool(np.asarray(res.hit).any())
+        assert bool(np.asarray(res.done).all())
+
+    def test_reput_higher_seq_wins(self, small_swarm):
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        keys = _rand_keys(10, 64)
+        v1 = jnp.full((64,), 111, jnp.uint32)
+        v2 = jnp.full((64,), 222, jnp.uint32)
+        store, _ = announce(swarm, cfg, store, SCFG, keys, v1,
+                            jnp.ones(64, jnp.uint32), 0,
+                            jax.random.PRNGKey(11))
+        store, _ = announce(swarm, cfg, store, SCFG, keys, v2,
+                            jnp.full((64,), 2, jnp.uint32), 1,
+                            jax.random.PRNGKey(12))
+        res = get_values(swarm, cfg, store, SCFG, keys,
+                         jax.random.PRNGKey(13))
+        hit = np.asarray(res.hit)
+        assert hit.mean() > 0.99
+        assert (np.asarray(res.val)[hit] == 222).all()
+        assert (np.asarray(res.seq)[hit] == 2).all()
+
+
+class TestListen:
+    def test_listen_notified_on_put(self, small_swarm):
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        p = 64
+        keys = _rand_keys(14, p)
+        regs = jnp.arange(p, dtype=jnp.int32)
+        store, _ = listen_at(swarm, cfg, store, SCFG, keys, regs,
+                             jax.random.PRNGKey(15))
+        # No put yet: nothing notified.
+        assert not bool(np.asarray(store.notified).any())
+        # Announce the first half of the keys.
+        store, _ = announce(swarm, cfg, store, SCFG, keys[:p // 2],
+                            jnp.ones(p // 2, jnp.uint32),
+                            jnp.ones(p // 2, jnp.uint32), 0,
+                            jax.random.PRNGKey(16))
+        notified = np.asarray(store.notified)[:p]
+        assert notified[:p // 2].mean() > 0.95, notified[:p // 2].mean()
+        assert not notified[p // 2:].any()
+
+
+class TestExpireRepublish:
+    def test_expire_ttl(self, small_swarm):
+        swarm, cfg = small_swarm
+        scfg = StoreConfig(slots=8, listen_slots=2, ttl=10,
+                           max_listeners=1024)
+        store = empty_store(cfg.n_nodes, scfg)
+        keys = _rand_keys(17, 64)
+        store, _ = announce(swarm, cfg, store, scfg, keys,
+                            jnp.ones(64, jnp.uint32),
+                            jnp.ones(64, jnp.uint32), 0,
+                            jax.random.PRNGKey(18))
+        assert int(np.asarray(store.used).sum()) > 0
+        store = expire(store, scfg, 5)   # within ttl
+        assert int(np.asarray(store.used).sum()) > 0
+        store = expire(store, scfg, 11)  # past ttl
+        assert int(np.asarray(store.used).sum()) == 0
+
+    def test_republish_restores_replication_after_churn(self, small_swarm):
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        p = 128
+        keys = _rand_keys(19, p)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 7
+        store, _ = announce(swarm, cfg, store, SCFG, keys, vals,
+                            jnp.ones(p, jnp.uint32), 0,
+                            jax.random.PRNGKey(20))
+        # Kill 40% of the swarm: replicas on dead nodes are gone.
+        dead_swarm = churn(swarm, jax.random.PRNGKey(21), 0.4, cfg)
+        # Every alive node republishes what it holds (small swarm:
+        # affordable; at scale you'd sample).
+        alive_idx = jnp.where(dead_swarm.alive, jnp.arange(cfg.n_nodes),
+                              -1)
+        store2, _ = republish_from(dead_swarm, cfg, store, SCFG,
+                                   alive_idx, 1, jax.random.PRNGKey(22))
+        res = get_values(dead_swarm, cfg, store2, SCFG, keys,
+                         jax.random.PRNGKey(23))
+        hit = np.asarray(res.hit)
+        assert hit.mean() > 0.98, hit.mean()
+        got = np.asarray(res.val)[hit]
+        assert (got == np.asarray(vals)[hit]).all()
+
+    def test_churn_without_republish_degrades(self, small_swarm):
+        """Sanity: the republish test is actually doing something."""
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        p = 128
+        keys = _rand_keys(24, p)
+        store, _ = announce(swarm, cfg, store, SCFG, keys,
+                            jnp.ones(p, jnp.uint32),
+                            jnp.ones(p, jnp.uint32), 0,
+                            jax.random.PRNGKey(25))
+        dead_swarm = churn(swarm, jax.random.PRNGKey(26), 0.9, cfg)
+        res = get_values(dead_swarm, cfg, store, SCFG, keys,
+                         jax.random.PRNGKey(27))
+        # With 90% of nodes dead and no maintenance, most replicas die.
+        assert np.asarray(res.hit).mean() < 0.9
