@@ -217,12 +217,14 @@ def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     """What each solicited node returns for each target.
 
     ``targets``: ``[L,5]``; ``nid``: ``[L,A]`` node indices (-1 = none).
-    Returns ``[L, A*2K]`` candidate indices: the solicited node's bucket
-    ``c = commonBits(self, target)`` (every member is strictly closer to
-    the target than the node itself) plus bucket ``c+1`` — together the
-    node's best approximation of "the 8 closest I know"
-    (``Dht::onFindNode`` src/dht.cpp:3189-3200).  Dead or empty slots
-    return -1s.
+    Returns ``(resp [L, A*2K], answered [L,A])``: candidate indices —
+    the solicited node's bucket ``c = commonBits(self, target)`` (every
+    member is strictly closer to the target than the node itself) plus
+    bucket ``c+1`` — together the node's best approximation of "the 8
+    closest I know" (``Dht::onFindNode`` src/dht.cpp:3189-3200).  Dead
+    or empty slots return -1s.  ``answered`` is the delivery mask: the
+    local engine always delivers to live targets; the sharded transport
+    may drop over-capacity queries (they retry next round).
     """
     n, b_total, k = cfg.n_nodes, cfg.n_buckets, cfg.bucket_k
     safe = jnp.clip(nid, 0, n - 1)
@@ -235,7 +237,7 @@ def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     resp = jnp.concatenate([rows0, rows1], axis=-1)             # [L,A,2K]
     ok = (nid >= 0) & swarm.alive[safe]
     resp = jnp.where(ok[..., None], resp, -1)
-    return resp.reshape(resp.shape[0], -1)
+    return resp.reshape(resp.shape[0], -1), ok
 
 
 def _select_alpha(st: LookupState, cfg: SwarmConfig) -> jax.Array:
@@ -270,7 +272,7 @@ def init_impl(ids: jax.Array, respond, cfg: SwarmConfig,
     """
     l = targets.shape[0]
     s = cfg.search_width
-    resp = respond(targets, origins[:, None])         # [L,2K]
+    resp, _ = respond(targets, origins[:, None])      # [L,2K]
     cand_idx = jnp.concatenate(
         [resp, jnp.full((l, max(0, s - resp.shape[1])), -1, jnp.int32)],
         axis=1) if resp.shape[1] < s else resp
@@ -287,19 +289,23 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     """Shared lock-step solicitation round (vectorized ``searchStep``,
     src/dht.cpp:1343-1464): select α unqueried, solicit via
     ``respond``, merge responses, re-sort, check sync quorum."""
-    sel = _select_alpha(st, cfg)                                # [L,A]
+    # Finished lookups stop soliciting: besides wasting gathers, their
+    # traffic would consume bounded all_to_all capacity and could
+    # starve still-active queries on a hot shard.
+    sel = jnp.where(st.done[:, None], -1, _select_alpha(st, cfg))  # [L,A]
     sel_alive = (sel >= 0) & alive[jnp.clip(sel, 0, cfg.n_nodes - 1)]
+    resp, answered = respond(st.targets, sel)                   # [L,A*2K]
     hit = st.idx[:, :, None] == sel[:, None, :]                 # [L,S,A]
     hit = hit & (sel[:, None, :] >= 0)
-    # Alive solicited nodes become "queried"; dead ones are evicted
+    # Answered solicitations become "queried"; dead nodes are evicted
     # from the shortlist entirely — the reference expires a node after
     # 3 unanswered attempts and replaces it with the next candidate
-    # (request.h:113, src/dht.cpp:1059-1074).
-    queried = st.queried | jnp.any(hit & sel_alive[:, None, :], axis=2)
+    # (request.h:113, src/dht.cpp:1059-1074).  Alive-but-unanswered
+    # (transport drop) stays unqueried and is re-solicited next round.
+    queried = st.queried | jnp.any(
+        hit & (sel_alive & answered)[:, None, :], axis=2)
     evict = jnp.any(hit & (~sel_alive & (sel >= 0))[:, None, :], axis=2)
     idx = jnp.where(evict, -1, st.idx)
-
-    resp = respond(st.targets, sel)                             # [L,A*2K]
     cand_idx = jnp.concatenate([idx, resp], axis=1)
     # Evicted frontier slots must not keep their old (now invalid)
     # distance keys.

@@ -23,6 +23,7 @@ Both run unmodified on the driver's virtual CPU mesh.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import jax
@@ -58,20 +59,31 @@ def data_parallel_lookup(swarm: Swarm, cfg: SwarmConfig,
 
 def _route_respond(tables_local: jax.Array, ids: jax.Array,
                    alive: jax.Array, targets: jax.Array, nid: jax.Array,
-                   cfg: SwarmConfig, n_shards: int) -> jax.Array:
+                   cfg: SwarmConfig, n_shards: int,
+                   capacity_factor: float):
     """Answer solicitations whose routing tables live on other shards.
 
     ``nid``: ``[Ll, A]`` global node indices (-1 = none).  Returns
-    ``[Ll, A*2K]`` global candidate indices.  Queries ship
-    ``(local_row, bucket, bucket+1)`` to the owner shard in capacity-Q
-    buckets (Q = Ll·A, the worst case of every query hitting one
-    shard), are answered by local gathers, and ship back — two
-    ``all_to_all`` per round, O(α·L/D) payload each.
+    ``(resp [Ll, A*2K], answered [Ll, A])``.  Queries ship
+    ``(local_row, bucket, bucket+1)`` to the owner shard in
+    fixed-capacity buckets of ``C = capacity_factor · Q/D`` (expected
+    load per shard times head-room — NOT the worst-case Q, which would
+    inflate shuffle traffic D×), are answered by local gathers, and
+    ship back — two ``all_to_all`` per round, O(α·L/D·c) payload each.
+    Queries landing past an owner's capacity are *dropped* this round
+    (``answered`` False): the origin keeps them unqueried and re-sends
+    next round, the lock-step analogue of the reference's request
+    retransmit after timeout (request.h:113).
     """
     n = cfg.n_nodes
     shard_n = n // n_shards
     ll, a = nid.shape
     q = ll * a
+    if math.isfinite(capacity_factor):
+        cap = min(q, max(a, int(math.ceil(q / n_shards
+                                          * capacity_factor))))
+    else:
+        cap = q
     flat = nid.reshape(-1)
     safe = jnp.clip(flat, 0, n - 1)
     ok = (flat >= 0) & alive[safe]
@@ -87,17 +99,24 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
     local_row = safe - owner * shard_n
     local_row = jnp.where(ok, local_row, -1)
 
-    # Position of each query within its owner's capacity-Q bucket.
-    onehot = owner[:, None] == jnp.arange(n_shards)[None, :]
+    # Position of each query within its owner's capacity-C bucket.
+    # Only real queries count — masked rows (-1) clip to node 0 and
+    # would otherwise inflate shard 0's positions past capacity,
+    # permanently starving genuine shard-0 traffic.
+    onehot = (owner[:, None] == jnp.arange(n_shards)[None, :]) \
+        & ok[:, None]
     pos = jnp.take_along_axis(
         jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1,
         owner[:, None], axis=1)[:, 0]
+    sent = ok & (pos < cap)
 
-    # One stacked [D, Q, 3] shuffle instead of three collectives: the
-    # per-collective launch latency sits on the lock-step critical path.
-    qbuf = jnp.full((n_shards, q, 3), -1, jnp.int32)
-    qbuf = qbuf.at[owner, pos].set(
-        jnp.stack([local_row, c0, c1], axis=-1))
+    # One stacked [D, C, 3] shuffle instead of three collectives: the
+    # per-collective launch latency sits on the lock-step critical
+    # path.  Over-capacity and masked rows write to a trash slot.
+    qbuf = jnp.full((n_shards, cap + 1, 3), -1, jnp.int32)
+    qbuf = qbuf.at[jnp.where(sent, owner, n_shards - 1),
+                   jnp.where(sent, pos, cap)].set(
+        jnp.stack([local_row, c0, c1], axis=-1))[:, :cap]
 
     a2a = partial(jax.lax.all_to_all, axis_name=AXIS, split_axis=0,
                   concat_axis=0, tiled=True)
@@ -108,18 +127,20 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
 
     # Owner-side gather of the two bucket rows.
     safe_row = jnp.clip(r_row, 0, shard_n - 1)
-    rows0 = tables_local[safe_row, r_c0]                     # [D,Q,K]
+    rows0 = tables_local[safe_row, r_c0]                     # [D,C,K]
     rows1 = tables_local[safe_row, r_c1]
-    resp = jnp.concatenate([rows0, rows1], axis=-1)          # [D,Q,2K]
+    resp = jnp.concatenate([rows0, rows1], axis=-1)          # [D,C,2K]
     resp = jnp.where((r_row >= 0)[..., None], resp, -1)
 
-    back = a2a(resp)                                         # [D,Q,2K]
-    mine = back[owner, pos]                                  # [Q,2K]
-    mine = jnp.where(ok[:, None], mine, -1)
-    return mine.reshape(ll, a * 2 * cfg.bucket_k)
+    back = a2a(resp)                                         # [D,C,2K]
+    mine = back[owner, jnp.clip(pos, 0, cap - 1)]            # [Q,2K]
+    mine = jnp.where(sent[:, None], mine, -1)
+    return (mine.reshape(ll, a * 2 * cfg.bucket_k),
+            sent.reshape(ll, a))
 
 
-def _sharded_body(cfg: SwarmConfig, n_shards: int, ids, tables_local,
+def _sharded_body(cfg: SwarmConfig, n_shards: int,
+                  capacity_factor: float, ids, tables_local,
                   alive, targets, key):
     """Runs per-device under shard_map: full lookup loop with routed
     responses.  Collective-synchronised while-loop (every shard decides
@@ -133,12 +154,20 @@ def _sharded_body(cfg: SwarmConfig, n_shards: int, ids, tables_local,
 
     def respond(tg, nid):
         return _route_respond(tables_local, ids, alive, tg, nid, cfg,
-                              n_shards)
+                              n_shards, capacity_factor)
+
+    def respond_init(tg, nid):
+        # The init seed is never re-sent: a capacity drop here would
+        # leave the lookup with an empty shortlist → instant
+        # exhaustion-done with nothing found.  It is also a one-off
+        # [D, Ll, 3] exchange (α=1), so run it uncapped.
+        return _route_respond(tables_local, ids, alive, tg, nid, cfg,
+                              n_shards, float("inf"))
 
     # Init: origin's own table answers first (hop 0).  The lock-step
     # round logic is the single shared implementation from
     # models.swarm; only ``respond`` differs between modes.
-    st = init_impl(ids, respond, cfg, targets, origins)
+    st = init_impl(ids, respond_init, cfg, targets, origins)
 
     def cond(carry):
         st, it = carry
@@ -155,18 +184,21 @@ def _sharded_body(cfg: SwarmConfig, n_shards: int, ids, tables_local,
     return found, st.hops, st.done
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh"))
+@partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor"))
 def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
-                   key: jax.Array, mesh: Mesh) -> LookupResult:
+                   key: jax.Array, mesh: Mesh,
+                   capacity_factor: float = 2.0) -> LookupResult:
     """Full lookup batch with routing tables sharded over ``mesh``.
 
     ``swarm.tables`` is sharded on the node axis; ``ids`` and ``alive``
     replicated; ``targets`` sharded on the lookup axis.  N and L must
-    divide the mesh size.
+    divide the mesh size.  ``capacity_factor`` sizes the per-shard
+    all_to_all buckets relative to the expected uniform load; queries
+    past capacity retry next round.
     """
     n_shards = mesh.shape[AXIS]
     fn = jax.shard_map(
-        partial(_sharded_body, cfg, n_shards),
+        partial(_sharded_body, cfg, n_shards, capacity_factor),
         mesh=mesh,
         in_specs=(P(), P(AXIS, None, None), P(), P(AXIS, None), P()),
         out_specs=(P(AXIS, None), P(AXIS), P(AXIS)),
