@@ -9,4 +9,4 @@ from .xor_metric import (  # noqa: F401
     xor_ids,
     xor_less,
 )
-from .pallas_kernels import nearest_ids  # noqa: F401
+from .pallas_kernels import nearest_ids, nearest_k_ids  # noqa: F401

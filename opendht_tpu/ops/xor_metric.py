@@ -114,7 +114,6 @@ def closest_nodes(ids: jax.Array, target: jax.Array, k: int) -> jax.Array:
     return idx[:k]
 
 
-@partial(jax.jit, static_argnames=("k", "prefilter"))
 def closest_nodes_batched(ids: jax.Array, targets: jax.Array, k: int,
                           prefilter: int = 32,
                           valid: jax.Array | None = None) -> jax.Array:
@@ -122,13 +121,36 @@ def closest_nodes_batched(ids: jax.Array, targets: jax.Array, k: int,
 
     ``ids``: ``[N,5]``, ``targets``: ``[L,5]`` → ``[L,k]`` indices.
     ``valid``: optional ``[N]`` bool — excluded rows never appear in
-    the result (they lose both the prefilter and the final sort).
+    the result.
 
-    Two-stage: ``lax.top_k`` on the negated first-64-bit surrogate
-    distance (cheap, MXU/VPU friendly, avoids sorting the full ``[L,N]``
-    plane), then an exact 5-limb sort over the ``prefilter`` shortlist.
-    Exact unless more than ``prefilter`` candidates tie on their first
-    64 distance bits (probability ≈ (N/2^64)·prefilter for random ids).
+    On TPU this dispatches to the Pallas streaming k-best kernel
+    (:func:`opendht_tpu.ops.pallas_kernels.nearest_k_ids`) — HBM
+    traffic O(L·5 + N·5) per tile pair, no ``[L,N]`` plane — so it
+    scales to the north-star sizes (L=1M targets over N=10M nodes
+    would need a 40 TB plane).  Elsewhere it falls back to the plane
+    implementation below (Pallas interpret mode is far slower than
+    XLA:CPU's fused top_k).
+    """
+    if jax.default_backend() == "tpu":
+        from .pallas_kernels import nearest_k_ids
+        return nearest_k_ids(ids, targets, k, valid=valid,
+                             margin=max(8, prefilter - k))
+    return closest_nodes_batched_plane(ids, targets, k, prefilter,
+                                       valid=valid)
+
+
+@partial(jax.jit, static_argnames=("k", "prefilter"))
+def closest_nodes_batched_plane(ids: jax.Array, targets: jax.Array,
+                                k: int, prefilter: int = 32,
+                                valid: jax.Array | None = None
+                                ) -> jax.Array:
+    """Plane-based k-closest (reference implementation / CPU path).
+
+    Two-stage: ``lax.top_k`` on the negated first-32-bit surrogate
+    distance over an explicit ``[L,N]`` plane, then an exact 5-limb
+    sort over the ``prefilter`` shortlist.  Exact unless more than
+    ``prefilter`` candidates tie on their first 32 distance bits
+    (probability ≈ (N/2^32)·prefilter for random ids).
     """
     # Surrogate: bit-inverted first distance limb: top_k on limb0;
     # ties broken within the shortlist's exact sort.

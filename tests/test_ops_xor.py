@@ -128,3 +128,59 @@ def test_pallas_nearest_includes_self(rng):
     got = np.asarray(nearest_ids(jnp.asarray(ids), jnp.asarray(ids[:5]),
                                  tile_l=8, tile_n=128))
     assert got.tolist() == [0, 1, 2, 3, 4]
+
+
+def test_pallas_nearest_high_bit_target_ignores_padding(rng):
+    """A target with leading 1-bits is CLOSE to the all-ones pad value;
+    padded tail entries must still never win."""
+    ids = random_ids(130, rng)  # 126 entries of padding at tile_n=256
+    targets = random_ids(4, rng)
+    targets[:, 0] |= np.uint32(0xFFF00000)  # force leading 1s
+    got = np.asarray(nearest_ids(jnp.asarray(ids), jnp.asarray(targets),
+                                 tile_l=8, tile_n=256))
+    for li in range(4):
+        want = brute_closest(ids, InfoHash.from_u32(targets[li]), 1)[0]
+        assert got[li] == want
+
+
+def test_pallas_nearest_k_matches_brute(rng):
+    from opendht_tpu.ops import nearest_k_ids
+    ids = random_ids(700, rng)  # non-multiple of tile_n: padding path
+    targets = random_ids(9, rng)
+    targets[0, 0] |= np.uint32(0xFFFF0000)  # pad-hazard row
+    got = np.asarray(nearest_k_ids(jnp.asarray(ids), jnp.asarray(targets),
+                                   8, tile_l=8, tile_n=256))
+    for li in range(9):
+        want = brute_closest(ids, InfoHash.from_u32(targets[li]), 8)
+        assert got[li].tolist() == want
+
+
+def test_pallas_nearest_k_respects_valid_mask(rng):
+    from opendht_tpu.ops import nearest_k_ids
+    ids = random_ids(400, rng)
+    targets = random_ids(5, rng)
+    valid = np.ones(400, bool)
+    valid[::3] = False
+    got = np.asarray(nearest_k_ids(
+        jnp.asarray(ids), jnp.asarray(targets), 8,
+        valid=jnp.asarray(valid), tile_l=8, tile_n=128))
+    alive = np.nonzero(valid)[0]
+    for li in range(5):
+        want_alive = brute_closest(ids[alive], InfoHash.from_u32(targets[li]), 8)
+        want = [int(alive[j]) for j in want_alive]
+        assert got[li].tolist() == want
+
+
+def test_pallas_nearest_k_fewer_than_k_valid(rng):
+    from opendht_tpu.ops import nearest_k_ids
+    ids = random_ids(64, rng)
+    targets = random_ids(2, rng)
+    valid = np.zeros(64, bool)
+    valid[:5] = True
+    got = np.asarray(nearest_k_ids(
+        jnp.asarray(ids), jnp.asarray(targets), 8,
+        valid=jnp.asarray(valid), tile_l=8, tile_n=64))
+    for li in range(2):
+        want = brute_closest(ids[:5], InfoHash.from_u32(targets[li]), 5)
+        assert got[li, :5].tolist() == want
+        assert got[li, 5:].tolist() == [-1, -1, -1]
