@@ -29,7 +29,7 @@ MAX_VALUE_SIZE = 64 * 1024
 RX_MAX_PACKET_TIME = 10.0     # total reassembly window
 RX_TIMEOUT = 3.0              # inter-part reassembly timeout
 MAX_MESSAGE_VALUE_COUNT = 50  # more values than this => header + parts
-AGENT = b"RNG1"               # wire agent tag (ref src/network_engine.cpp:43)
+AGENT = "RNG1"                # wire agent tag, packed as msgpack str (ref src/network_engine.cpp:43)
 
 # --- storage ---------------------------------------------------------------
 MAX_STORAGE_SIZE = 64 * 1024 * 1024

@@ -711,7 +711,10 @@ class Dht:
     def on_reported_addr(self, nid: InfoHash, addr: SockAddr) -> None:
         b = self.buckets(addr.family).find_bucket(nid)
         b.time = self.scheduler.time()
-        if addr:
+        # The ``sa`` echo carries no port (insertAddr packs the bare
+        # ip); the reference records it anyway (onReportedAddr checks
+        # socklen, not port — src/dht.cpp:3174-3180).
+        if addr.host:
             for entry in self.reported_addr:
                 if entry[1] == addr:
                     entry[0] += 1

@@ -316,18 +316,22 @@ class FieldValue:
         return f_true
 
     def pack(self):
+        """Wire form {"f": field, "v": value}
+        (ref FieldValue::msgpack_pack value.h:572-590)."""
         if self.field in (Field.Id, Field.ValueType, Field.SeqNum):
-            return [int(self.field), self.int_value]
+            return {"f": int(self.field), "v": self.int_value}
         if self.field == Field.OwnerPk:
-            return [int(self.field), bytes(self.hash_value)]
+            return {"f": int(self.field), "v": bytes(self.hash_value)}
         if self.field == Field.UserType:
-            return [int(self.field), self.blob_value]
-        return [int(self.field), None]
+            return {"f": int(self.field), "v": self.blob_value}
+        return {"f": int(self.field), "v": None}
 
     @classmethod
     def unpack(cls, obj) -> "FieldValue":
-        field = Field(obj[0])
-        raw = obj[1]
+        if isinstance(obj, dict):
+            field, raw = Field(obj["f"]), obj.get("v")
+        else:  # legacy [field, value] pair
+            field, raw = Field(obj[0]), obj[1]
         if field == Field.OwnerPk:
             from ..utils.infohash import InfoHash
             return cls(field, InfoHash(bytes(raw)))

@@ -259,27 +259,29 @@ class NetworkEngine:
     def send_announce_value(self, node: Node, info_hash: InfoHash, value: Value,
                             created: Optional[float], token: bytes,
                             on_done=None, on_expired=None) -> Request:
-        created_offset = None
+        # Absolute creation time, only sent when in the past (the
+        # reference packs to_time_t(created) iff created < now,
+        # src/network_engine.cpp:1103-1106; receiver clamps to now).
+        created_abs = None
         if created is not None and created < self.scheduler.time():
-            created_offset = self.scheduler.time() - created
+            created_abs = created
         packed = value.packed()
         if len(packed) < MAX_PACKET_VALUE_SIZE:
             return self._send_request(
                 ANNOUNCE_VALUE, node,
                 lambda tid: self.builder.announce_value(
-                    tid, info_hash, value, created_offset, token),
+                    tid, info_hash, value, created_abs, token),
                 on_done, on_expired)
         # fragmented announce: header + parts
         blob = msgpack.packb([value.pack()])
 
         def build_header(tid: bytes) -> bytes:
-            args = {"h": bytes(info_hash), "token": token,
-                    "psize": len(blob), "_q": "put",
-                    "id": bytes(self.myid)}
-            if created_offset is not None:
-                args["c"] = created_offset
+            args = {"id": bytes(self.myid), "h": bytes(info_hash),
+                    "token": token, "psize": len(blob), "_q": "put"}
+            if created_abs is not None:
+                args["c"] = int(created_abs)
             env = {"a": args, "q": args.pop("_q"), "t": tid, "y": "q",
-                   "v": b"RNG1"}
+                   "v": "RNG1"}
             if self.network:
                 env["n"] = self.network
             return msgpack.packb(env)
@@ -329,14 +331,14 @@ class NetworkEngine:
             r["exp"] = True
         if total < MAX_PACKET_VALUE_SIZE and len(values) <= MAX_MESSAGE_VALUE_COUNT:
             r["values"] = packed
-            env = {"r": r, "t": socket_id, "y": "r", "v": b"RNG1"}
+            env = {"r": r, "t": socket_id, "y": "r", "v": "RNG1"}
             if self.network:
                 env["n"] = self.network
             self._send(msgpack.packb(env), node.addr)
         else:
             blob = msgpack.packb(packed)
             r["psize"] = len(blob)
-            env = {"r": r, "t": socket_id, "y": "r", "v": b"RNG1"}
+            env = {"r": r, "t": socket_id, "y": "r", "v": "RNG1"}
             if self.network:
                 env["n"] = self.network
             self._send(msgpack.packb(env), node.addr)
@@ -547,7 +549,9 @@ class NetworkEngine:
             elif msg.type == MessageType.AnnounceValue:
                 created = None
                 if msg.created is not None:
-                    created = now - msg.created
+                    # Absolute time, clamped to now (importValues-style
+                    # clamp, ref src/dht.cpp:3069-3073).
+                    created = min(now, msg.created)
                 ans = self.handler.on_announce(node, msg.info_hash, msg.values,
                                                created, msg.token)
                 self._send(self.builder.value_announced(msg.tid, from_addr,

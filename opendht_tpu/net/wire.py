@@ -95,8 +95,8 @@ class ParsedMessage:
     __slots__ = ("type", "tid", "id", "network", "info_hash", "target",
                  "token", "value_id", "values", "fields", "field_values",
                  "nodes4", "nodes6", "addr", "created", "socket_id", "want",
-                 "query", "error_code", "is_reply", "part_offset",
-                 "part_data", "value_parts_total")
+                 "query", "error_code", "is_reply", "part_index",
+                 "part_offset", "part_data", "value_parts_total")
 
     def __init__(self):
         self.type = None
@@ -119,6 +119,7 @@ class ParsedMessage:
         self.query: Optional[Query] = None
         self.error_code = 0
         self.is_reply = False
+        self.part_index = 0
         self.part_offset = 0
         self.part_data = b""
         self.value_parts_total = 0
@@ -143,11 +144,16 @@ def parse_message(data: bytes) -> ParsedMessage:
         return m
 
     if y == "v":
-        # fragmented value part (ref :872-875)
+        # fragmented value part: p = {value_index: {o, d}} (ref :872-875)
         m.type = MessageType.ValueData
         p = o.get("p", {})
-        m.part_offset = int(p.get("o", 0))
-        m.part_data = bytes(p.get("d", b""))
+        if p and not ("o" in p or "d" in p):
+            m.part_index, inner = next(iter(p.items()))
+            m.part_index = int(m.part_index)
+        else:  # tolerate the un-indexed flat form
+            inner = p
+        m.part_offset = int(inner.get("o", 0))
+        m.part_data = bytes(inner.get("d", b""))
         return m
 
     body = o.get("r") if y == "r" else o.get("a", {})
@@ -171,7 +177,7 @@ def parse_message(data: bytes) -> ParsedMessage:
     if "sid" in body:
         m.socket_id = bytes(body["sid"])
     if "w" in body:
-        m.want = int(body["w"])
+        m.want = unpack_want(body["w"])
     if "c" in body:
         m.created = float(body["c"])
     if "q" in body and y != "r" and isinstance(body["q"], dict):
@@ -203,8 +209,36 @@ def parse_message(data: bytes) -> ParsedMessage:
     return m
 
 
+def pack_want(want: int) -> list:
+    """``w`` travels as an array of OS address-family constants
+    (AF_INET=2 / AF_INET6=10, ref src/network_engine.cpp:705-709)."""
+    out = []
+    if want & WANT4:
+        out.append(AF_INET)
+    if want & WANT6:
+        out.append(AF_INET6)
+    return out
+
+
+def unpack_want(obj) -> int:
+    if isinstance(obj, int):  # tolerate the bitmask form
+        return obj
+    w = 0
+    for af in obj or []:
+        if af == AF_INET:
+            w |= WANT4
+        elif af == AF_INET6:
+            w |= WANT6
+    return w
+
+
 class MessageBuilder:
-    """Builds outbound messages (the serialization half of the engine)."""
+    """Builds outbound messages (the serialization half of the engine).
+
+    Key order inside every map mirrors the reference packers exactly
+    (src/network_engine.cpp:634-1250) so messages are byte-identical —
+    pinned by tests/test_wire_golden.py.
+    """
 
     def __init__(self, myid: InfoHash, network: int = 0):
         self.myid = myid
@@ -222,15 +256,28 @@ class MessageBuilder:
         return msgpack.packb(env)
 
     def _query(self, tid: bytes, method: str, args: dict) -> bytes:
-        args["id"] = bytes(self.myid)
-        args["_q"] = method
-        return self._envelope(tid, "q", "a", args)
+        # "id" is always the first argument key (every reference packer
+        # packs it before anything else).
+        full = {"id": bytes(self.myid)}
+        full.update(args)
+        full["_q"] = method
+        return self._envelope(tid, "q", "a", full)
 
-    def _reply(self, tid: bytes, fields: dict, dest: SockAddr) -> bytes:
-        fields["id"] = bytes(self.myid)
+    def _reply(self, tid: bytes, dest: Optional[SockAddr],
+               pre: Optional[dict] = None,
+               post: Optional[dict] = None) -> bytes:
+        """Reply body: id, then ``pre`` fields, then the echoed source
+        address, then ``post`` fields — the reference's insertAddr call
+        position varies per reply type."""
+        r = {"id": bytes(self.myid)}
+        if pre:
+            r.update(pre)
         if dest:
-            fields["sa"] = dest.pack_ip()
-        return self._envelope(tid, "r", "r", fields)
+            # IP only, no port (insertAddr src/network_engine.cpp:604-613)
+            r["sa"] = dest.pack_ip()[:-2]
+        if post:
+            r.update(post)
+        return self._envelope(tid, "r", "r", r)
 
     # -- queries -----------------------------------------------------------
     def ping(self, tid: bytes) -> bytes:
@@ -239,7 +286,7 @@ class MessageBuilder:
     def find_node(self, tid: bytes, target: InfoHash, want: int) -> bytes:
         args = {"target": bytes(target)}
         if want > 0:
-            args["w"] = want
+            args["w"] = pack_want(want)
         return self._query(tid, "find", args)
 
     def get_values(self, tid: bytes, info_hash: InfoHash, query: Optional[Query],
@@ -248,7 +295,7 @@ class MessageBuilder:
         if query:
             args["q"] = query.pack()
         if want > 0:
-            args["w"] = want
+            args["w"] = pack_want(want)
         return self._query(tid, "get", args)
 
     def listen(self, tid: bytes, info_hash: InfoHash, token: bytes,
@@ -259,11 +306,13 @@ class MessageBuilder:
         return self._query(tid, "listen", args)
 
     def announce_value(self, tid: bytes, info_hash: InfoHash, value: Value,
-                       created_offset: Optional[float], token: bytes) -> bytes:
-        args = {"h": bytes(info_hash), "values": [value.pack()],
-                "token": token}
-        if created_offset is not None:
-            args["c"] = created_offset
+                       created: Optional[float], token: bytes) -> bytes:
+        """``created`` is absolute seconds (the reference packs
+        ``to_time_t(created)``, clamped to now by the receiver)."""
+        args = {"h": bytes(info_hash), "values": [value.pack()]}
+        if created is not None:
+            args["c"] = int(created)
+        args["token"] = token
         return self._query(tid, "put", args)
 
     def refresh_value(self, tid: bytes, info_hash: InfoHash, vid: int,
@@ -273,7 +322,7 @@ class MessageBuilder:
 
     # -- replies -----------------------------------------------------------
     def pong(self, tid: bytes, dest: SockAddr) -> bytes:
-        return self._reply(tid, {}, dest)
+        return self._reply(tid, dest)
 
     def nodes_values(self, tid: bytes, dest: SockAddr, nodes4: bytes,
                      nodes6: bytes, values: Optional[list] = None,
@@ -292,26 +341,35 @@ class MessageBuilder:
             r["psize"] = values_size
         if fields:
             r["fields"] = fields
-        return self._reply(tid, r, dest)
+        return self._reply(tid, dest, post=r)
 
     def listen_confirm(self, tid: bytes, dest: SockAddr) -> bytes:
-        return self._reply(tid, {}, dest)
+        return self._reply(tid, dest)
 
     def value_announced(self, tid: bytes, dest: SockAddr, vid: int) -> bytes:
-        return self._reply(tid, {"vid": vid}, dest)
+        # r = {id, vid, sa} (sendValueAnnounced :1198-1218)
+        return self._reply(tid, dest, pre={"vid": vid})
 
-    def value_part(self, tid: bytes, offset: int, chunk: bytes) -> bytes:
-        env = {"y": "v", "t": tid, "p": {"o": offset, "d": chunk},
-               "v": AGENT}
+    def value_part(self, tid: bytes, offset: int, chunk: bytes,
+                   index: int = 0) -> bytes:
+        """Fragment envelope: [n,] y, t, p{index: {o, d}}
+        (sendValueParts :853-882 — network id first, no agent tag)."""
+        env = {}
         if self.network:
             env["n"] = self.network
+        env["y"] = "v"
+        env["t"] = tid
+        env["p"] = {index: {"o": offset, "d": chunk}}
         return msgpack.packb(env)
 
     def error(self, tid: bytes, code: int, message: str,
               include_id: bool = False) -> bytes:
-        env = {"e": [code, message], "t": tid, "y": "e", "v": AGENT}
+        env = {"e": [code, message]}
         if include_id:
             env["r"] = {"id": bytes(self.myid)}
+        env["t"] = tid
+        env["y"] = "e"
+        env["v"] = AGENT
         if self.network:
             env["n"] = self.network
         return msgpack.packb(env)

@@ -77,10 +77,17 @@ class SockAddr:
 
     @classmethod
     def unpack_ip(cls, data: bytes) -> "SockAddr":
+        """6/18 bytes = ip+port (node buffers); 4/16 bytes = bare ip,
+        port 0 (the ``sa`` echo carries no port — insertAddr,
+        ref src/network_engine.cpp:604-613)."""
         if len(data) == 6:
             return cls(str(ipaddress.IPv4Address(data[:4])),
                        int.from_bytes(data[4:6], "big"), AF_INET)
         if len(data) == 18:
             return cls(str(ipaddress.IPv6Address(data[:16])),
                        int.from_bytes(data[16:18], "big"), AF_INET6)
+        if len(data) == 4:
+            return cls(str(ipaddress.IPv4Address(data)), 0, AF_INET)
+        if len(data) == 16:
+            return cls(str(ipaddress.IPv6Address(data)), 0, AF_INET6)
         raise ValueError(f"bad packed addr length {len(data)}")
