@@ -11,10 +11,12 @@ GnuTLS/nettle.  Scheme parity:
   (ref: src/crypto.cpp:465-508; GCM layout 120-181)
 * key id: SHA-1 of the DER SubjectPublicKeyInfo
   (ref: PublicKey::getId src/crypto.cpp:511-518)
-* password KDF: the reference uses argon2i (src/crypto.cpp:194-206); we use
-  scrypt (argon2 is not available in-image) — flagged in the API.
-* identities: X.509 chains, ``generate_identity`` building CA + leaf
-  (ref: src/crypto.cpp:520-1105)
+* password KDF: argon2i(t=16, m=64 MiB, p=1) + multi-size hash truncate,
+  matching the reference byte-for-byte (src/crypto.cpp:194-206; vendored
+  argon2 in src/argon2/)
+* identities: X.509 chains, ``generate_identity`` building CA + leaf,
+  ``RevocationList`` X.509 CRLs (ref: src/crypto.cpp:520-1105,
+  include/opendht/crypto.h:165-231)
 """
 
 from __future__ import annotations
@@ -24,12 +26,12 @@ import hashlib
 import os
 from typing import List, Optional, Tuple
 
+from argon2 import low_level as argon2_low_level
 from cryptography import x509
 from cryptography.hazmat.primitives import hashes, serialization
 from cryptography.hazmat.primitives.asymmetric import padding, rsa
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-from cryptography.hazmat.primitives.kdf.scrypt import Scrypt
-from cryptography.x509.oid import NameOID
+from cryptography.x509.oid import ExtensionOID, NameOID
 
 from ..utils.infohash import InfoHash
 
@@ -69,15 +71,31 @@ def aes_decrypt(data: bytes, key: bytes) -> bytes:
         raise DecryptError("Can't decrypt data") from e
 
 
+def hash_data(data: bytes, hash_len: int) -> bytes:
+    """Multi-size hash: SHA-512 above 32 B, SHA-256 above 16 B, else
+    SHA-1, truncated to ``hash_len``
+    (ref: hash/gnutlsHashAlgo src/crypto.cpp:86-97,209-221)."""
+    if hash_len > 32:
+        h = hashlib.sha512(data).digest()
+    elif hash_len > 16:
+        h = hashlib.sha256(data).digest()
+    else:
+        h = hashlib.sha1(data).digest()
+    return h[:hash_len]
+
+
 def stretch_key(password: str, salt: Optional[bytes], key_length: int = 32
                 ) -> Tuple[bytes, bytes]:
-    """Password KDF (scrypt here; argon2i in the reference
-    src/crypto.cpp:194-206)."""
+    """Password KDF — argon2i(t=16, m=64 MiB, p=1, 32 B raw) then the
+    multi-size hash down to ``key_length``, byte-identical to the
+    reference's ``stretchKey`` (src/crypto.cpp:194-206)."""
     if not salt:
         salt = os.urandom(PASSWORD_SALT_LENGTH)
-    key = Scrypt(salt=salt, length=key_length, n=2**15, r=8, p=1).derive(
-        password.encode("utf-8"))
-    return key, salt
+    raw = argon2_low_level.hash_secret_raw(
+        secret=password.encode("utf-8"), salt=salt, time_cost=16,
+        memory_cost=64 * 1024, parallelism=1, hash_len=32,
+        type=argon2_low_level.Type.I)
+    return hash_data(raw, key_length), salt
 
 
 def password_encrypt(data: bytes, password: str) -> bytes:
@@ -190,18 +208,143 @@ class PrivateKey:
         return aes_decrypt(cipher[block:], head)
 
 
+def _der_object_len(data: bytes) -> int:
+    """Total length (header + body) of the DER object at data[0]."""
+    if len(data) < 2 or data[0] != 0x30:
+        raise CryptoException("bad DER sequence")
+    first = data[1]
+    if first < 0x80:
+        return 2 + first
+    nlen = first & 0x7F
+    if len(data) < 2 + nlen:
+        raise CryptoException("truncated DER length")
+    return 2 + nlen + int.from_bytes(data[2:2 + nlen], "big")
+
+
+class RevocationList:
+    """X.509 certificate revocation list
+    (ref: include/opendht/crypto.h:165-231, src/crypto.cpp:520-680).
+
+    Accumulates revoked certificates, then :meth:`sign` produces the
+    DER CRL; ``unpack``/``pack`` round-trip the DER form (the msgpack
+    form is a bin of the DER, crypto.h:186-192).
+    """
+
+    def __init__(self, packed: Optional[bytes] = None):
+        self._crl: Optional[x509.CertificateRevocationList] = None
+        self._pending: List[Tuple[int, datetime.datetime]] = []
+        if packed:
+            self.unpack(packed)
+
+    # -- serialization -----------------------------------------------------
+    def unpack(self, data: bytes) -> None:
+        self._crl = x509.load_der_x509_crl(data)
+
+    def get_packed(self) -> bytes:
+        if self._crl is None:
+            raise CryptoException("Revocation list is not signed")
+        return self._crl.public_bytes(serialization.Encoding.DER)
+
+    # -- edition -----------------------------------------------------------
+    def revoke(self, crt: "Certificate",
+               when: Optional[datetime.datetime] = None) -> None:
+        """Mark ``crt`` revoked (effective at ``when``, default now) —
+        takes effect in the next :meth:`sign` (ref: crypto.h:196)."""
+        when = when or datetime.datetime.now(datetime.timezone.utc)
+        self._pending.append((crt._cert.serial_number, when))
+
+    def sign(self, key: "PrivateKey", crt: "Certificate",
+             validity_period: Optional[datetime.timedelta] = None) -> None:
+        """Sign with the issuer's key; ``validity_period`` sets the
+        next-update time (ref: RevocationList::sign crypto.h:200-205)."""
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (x509.CertificateRevocationListBuilder()
+                   .issuer_name(crt._cert.subject)
+                   .last_update(now)
+                   .next_update(now + (validity_period
+                                       or datetime.timedelta(days=365 * 10)))
+                   .add_extension(
+                       x509.CRLNumber(int(now.timestamp())), critical=False))
+        if self._crl is not None:
+            for r in self._crl:
+                builder = builder.add_revoked_certificate(r)
+        for serial, when in self._pending:
+            builder = builder.add_revoked_certificate(
+                x509.RevokedCertificateBuilder()
+                .serial_number(serial).revocation_date(when).build())
+        self._pending.clear()
+        self._crl = builder.sign(key._sk, hashes.SHA512())
+
+    # -- queries -----------------------------------------------------------
+    def is_revoked(self, crt: "Certificate") -> bool:
+        serial = crt._cert.serial_number
+        if any(s == serial for s, _ in self._pending):
+            return True
+        if self._crl is None:
+            return False
+        return self._crl.get_revoked_certificate_by_serial_number(
+            serial) is not None
+
+    def is_signed_by(self, issuer: "Certificate") -> bool:
+        if self._crl is None:
+            return False
+        try:
+            return bool(self._crl.is_signature_valid(
+                issuer._cert.public_key()))
+        except Exception:
+            return False
+
+    def get_number(self) -> int:
+        """CRL number extension (ref: crypto.h:211-214)."""
+        if self._crl is None:
+            return 0
+        try:
+            ext = self._crl.extensions.get_extension_for_oid(
+                ExtensionOID.CRL_NUMBER)
+            return int(ext.value.crl_number)
+        except x509.ExtensionNotFound:
+            return 0
+
+    def get_issuer_name(self) -> str:
+        if self._crl is None:
+            return ""
+        attrs = self._crl.issuer.get_attributes_for_oid(NameOID.COMMON_NAME)
+        return attrs[0].value if attrs else ""
+
+    def get_update_time(self) -> Optional[datetime.datetime]:
+        return self._crl.last_update_utc if self._crl is not None else None
+
+    def get_next_update_time(self) -> Optional[datetime.datetime]:
+        return self._crl.next_update_utc if self._crl is not None else None
+
+
 class Certificate:
     """X.509 certificate (chain link) (ref: include/opendht/crypto.h:234-340)."""
 
-    __slots__ = ("_cert", "issuer")
+    __slots__ = ("_cert", "issuer", "revocation_lists")
 
     def __init__(self, cert, issuer: Optional["Certificate"] = None):
         self._cert = cert
         self.issuer = issuer
+        self.revocation_lists: List[RevocationList] = []
 
     @classmethod
     def from_der(cls, der: bytes) -> "Certificate":
-        return cls(x509.load_der_x509_certificate(der))
+        """Parse a certificate or a leaf-first chain (the reference's
+        Certificate(Blob) iterates every DER cert in the blob and links
+        issuers, ref src/crypto.cpp:560-600)."""
+        certs = []
+        rest = der
+        while rest:
+            clen = _der_object_len(rest)
+            certs.append(x509.load_der_x509_certificate(rest[:clen]))
+            rest = rest[clen:]
+        if not certs:
+            raise CryptoException("empty certificate blob")
+        chain = None
+        for c in reversed(certs):  # build from root down
+            chain = cls(c, issuer=chain)
+        return chain
 
     def packed(self) -> bytes:
         """Full chain DER, leaf first (ref: crypto.h:187-193 packs chain)."""
@@ -226,6 +369,22 @@ class Certificate:
             return bool(bc.value.ca)
         except x509.ExtensionNotFound:
             return False
+
+    # -- revocation (ref: crypto.h:386-389) -------------------------------
+    def add_revocation_list(self, crl: RevocationList) -> None:
+        """Attach a CRL issued by this (CA) certificate; rejected unless
+        actually signed by us (ref: Certificate::addRevocationList
+        src/crypto.cpp — gnutls verifies the CRL signature)."""
+        if not crl.is_signed_by(self):
+            raise CryptoException("CRL is not signed by this certificate")
+        self.revocation_lists.append(crl)
+
+    def get_revocation_lists(self) -> List[RevocationList]:
+        return list(self.revocation_lists)
+
+    def is_revoked(self, crt: "Certificate") -> bool:
+        """True if any CRL attached to this issuer revokes ``crt``."""
+        return any(crl.is_revoked(crt) for crl in self.revocation_lists)
 
     def __eq__(self, other):
         return isinstance(other, Certificate) and self.packed() == other.packed()

@@ -131,6 +131,7 @@ class SecureDht(Dht):
         self.certificate: Optional[Certificate] = config.identity.certificate
 
         self.nodes_certificates: Dict[InfoHash, Certificate] = {}
+        self.trusted_certificates: List[Certificate] = []
         self.nodes_pubkeys: Dict[InfoHash, PublicKey] = {}
         # Pluggable local certificate store
         # (ref: setLocalCertificateStore securedht.h:153-156)
@@ -203,7 +204,36 @@ class SecureDht(Dht):
     # certificate discovery                                              #
     # ------------------------------------------------------------------ #
 
+    def add_trusted_certificate(self, cert: Certificate) -> None:
+        """Register a trust-anchor (CA) certificate whose CRLs are
+        consulted when importing certificates — the local trust-list
+        role gnutls plays in the reference (crypto.h:386-389).
+        Already-cached certificates the new anchor revokes are
+        evicted, so revocation applies retroactively."""
+        self.trusted_certificates.append(cert)
+        self.nodes_certificates = {
+            cid: crt for cid, crt in self.nodes_certificates.items()
+            if not cert.is_revoked(crt)}
+
+    def is_certificate_revoked(self, crt: Certificate) -> bool:
+        """True if any CRL attached to the cert's issuer chain, to our
+        own trust chain, or to a registered trust anchor revokes it
+        (the gnutls chain verification with CRLs the reference performs
+        on import, ref src/crypto.cpp:520-680, crypto.h:386-389)."""
+        anchors = list(self.trusted_certificates)
+        c = crt.issuer
+        while c is not None:
+            anchors.append(c)
+            c = c.issuer
+        own = self.certificate
+        while own is not None:
+            anchors.append(own)
+            own = own.issuer
+        return any(a.is_revoked(crt) for a in anchors)
+
     def register_certificate(self, cert: Certificate) -> InfoHash:
+        if self.is_certificate_revoked(cert):
+            raise CryptoException("certificate is revoked")
         cid = cert.get_id()
         self.nodes_certificates[cid] = cert
         return cid
@@ -233,7 +263,13 @@ class SecureDht(Dht):
         if self.local_query_method is not None:
             res = self.local_query_method(h)
             if res:
-                self.nodes_certificates[h] = res[0]
+                try:
+                    # Same import gate as the network path — the local
+                    # store may hold since-revoked certificates.
+                    self.register_certificate(res[0])
+                except CryptoException:
+                    cb(None)
+                    return
                 cb(res[0])
                 return
 
@@ -248,8 +284,11 @@ class SecureDht(Dht):
                 except Exception:
                     continue
                 if crt.get_id() == h:
+                    try:
+                        self.register_certificate(crt)
+                    except Exception:
+                        continue  # revoked: keep looking
                     state["found"] = crt
-                    self.register_certificate(crt)
                     return False  # stop the get
             return True
 

@@ -82,6 +82,93 @@ def test_password_encrypt():
         password_decrypt(ct, "wrong")
 
 
+class TestArgon2Kdf:
+    def test_argon2i_known_answer(self):
+        """Upstream argon2 KAT (phc-winner-argon2 README):
+        argon2i v1.3, t=2, m=2^16 KiB, p=4, 24-byte tag."""
+        from argon2.low_level import Type, hash_secret_raw
+        out = hash_secret_raw(secret=b"password", salt=b"somesalt",
+                              time_cost=2, memory_cost=1 << 16,
+                              parallelism=4, hash_len=24, type=Type.I)
+        assert out.hex() == ("45d7ac72e76f242b20b77b9bf9bf9d59"
+                             "15894e669a24e6c6")
+
+    def test_stretch_key_is_argon2i_then_hash(self):
+        """stretch_key = sha(argon2i(t=16, m=64 MiB, p=1, 32 B))[:n]
+        (ref src/crypto.cpp:194-206 + hash :209-221)."""
+        import hashlib
+
+        from argon2.low_level import Type, hash_secret_raw
+
+        from opendht_tpu.crypto.identity import stretch_key
+        salt = b"\x02" * 16
+        raw = hash_secret_raw(secret=b"pw", salt=salt, time_cost=16,
+                              memory_cost=64 * 1024, parallelism=1,
+                              hash_len=32, type=Type.I)
+        key32, _ = stretch_key("pw", salt, 32)
+        assert key32 == hashlib.sha256(raw).digest()
+        key64, _ = stretch_key("pw", salt, 64)
+        assert key64 == hashlib.sha512(raw).digest()
+
+    def test_hash_data_length_mapping(self):
+        """gnutlsHashAlgo mapping: >32 SHA512, >16 SHA256, else SHA1."""
+        import hashlib
+
+        from opendht_tpu.crypto.identity import hash_data
+        d = b"abc"
+        assert hash_data(d, 20) == hashlib.sha256(d).digest()[:20]
+        assert hash_data(d, 16) == hashlib.sha1(d).digest()[:16]
+        assert hash_data(d, 48) == hashlib.sha512(d).digest()[:48]
+
+
+class TestRevocationList:
+    def test_revoke_and_query(self):
+        from opendht_tpu.crypto.identity import RevocationList
+        ca = generate_identity("ca", key_length=KEY_LEN)
+        leaf = generate_identity("node", ca, key_length=KEY_LEN)
+        other = generate_identity("other", ca, key_length=KEY_LEN)
+        crl = RevocationList()
+        crl.revoke(leaf.certificate)
+        assert crl.is_revoked(leaf.certificate)  # pending counts
+        crl.sign(ca.key, ca.certificate)
+        assert crl.is_revoked(leaf.certificate)
+        assert not crl.is_revoked(other.certificate)
+        assert crl.is_signed_by(ca.certificate)
+        assert not crl.is_signed_by(other.certificate)
+        assert crl.get_issuer_name() == "ca"
+        assert crl.get_number() > 0
+        assert crl.get_update_time() is not None
+
+    def test_pack_unpack_roundtrip(self):
+        from opendht_tpu.crypto.identity import RevocationList
+        ca = generate_identity("ca", key_length=KEY_LEN)
+        leaf = generate_identity("node", ca, key_length=KEY_LEN)
+        crl = RevocationList()
+        crl.revoke(leaf.certificate)
+        crl.sign(ca.key, ca.certificate)
+        der = crl.get_packed()
+        crl2 = RevocationList(der)
+        assert crl2.is_revoked(leaf.certificate)
+        assert crl2.is_signed_by(ca.certificate)
+        assert crl2.get_number() == crl.get_number()
+
+    def test_certificate_attach_requires_signature(self):
+        from opendht_tpu.crypto.identity import CryptoException, RevocationList
+        ca = generate_identity("ca", key_length=KEY_LEN)
+        mallory = generate_identity("mallory", key_length=KEY_LEN)
+        leaf = generate_identity("node", ca, key_length=KEY_LEN)
+        crl = RevocationList()
+        crl.revoke(leaf.certificate)
+        crl.sign(mallory.key, mallory.certificate)  # wrong issuer
+        with pytest.raises(CryptoException):
+            ca.certificate.add_revocation_list(crl)
+        good = RevocationList()
+        good.revoke(leaf.certificate)
+        good.sign(ca.key, ca.certificate)
+        ca.certificate.add_revocation_list(good)
+        assert ca.certificate.is_revoked(leaf.certificate)
+
+
 def test_generate_identity_chain():
     ca = generate_identity("ca", key_length=KEY_LEN)
     assert ca and ca.certificate.is_ca()

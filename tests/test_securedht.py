@@ -164,3 +164,53 @@ def test_forged_signature_rejected_by_store_policy(cluster, identities):
     # plain Dht nodes store blindly (same split as the reference, where
     # only SecureDht wraps types with signature-checking policies).
     assert b.get_local(h) == []
+
+
+def test_revoked_certificate_rejected():
+    """A certificate revoked by its CA's CRL is refused by
+    register_certificate and never returned by find_certificate
+    (ref: RevocationList crypto.h:165-231; chain check on import)."""
+    from opendht_tpu.crypto.identity import CryptoException, RevocationList
+
+    ca = generate_identity("ca", key_length=1024)
+    leaf = generate_identity("node", ca, key_length=1024)
+    crl = RevocationList()
+    crl.revoke(leaf.certificate)
+    crl.sign(ca.key, ca.certificate)
+    ca.certificate.add_revocation_list(crl)
+
+    c = SimCluster(0, seed=11)
+    other = c.add_secure_node(generate_identity("other", key_length=1024))
+    for _ in range(2):
+        c.add_node()
+    c.interconnect()
+    c.run(2.0)
+
+    # leaf's chain carries the CA cert holding the CRL.
+    with pytest.raises(CryptoException):
+        other.register_certificate(leaf.certificate)
+    assert other.get_certificate(leaf.certificate.get_id()) is None
+
+    # Publish the revoked cert into the DHT the normal way.  The wire
+    # form is the bare chain (no CRL rides along), so rejection relies
+    # on the verifier trusting the CA: before the anchor is installed
+    # the cert IS found; after add_trusted_certificate it is refused.
+    from opendht_tpu.crypto.securedht import CERTIFICATE_TYPE_ID
+    v = Value(leaf.certificate.packed(), CERTIFICATE_TYPE_ID,
+              value_id=1)
+    c.nodes[-1].put(leaf.certificate.get_id(), v)
+    c.run(3.0)
+    res = {}
+    other.find_certificate(leaf.certificate.get_id(),
+                           lambda crt: res.update(crt=crt))
+    assert c.run_until(lambda: "crt" in res, 30)
+    assert res["crt"] is not None  # not vacuous: cert is reachable
+
+    # Installing the anchor evicts the already-cached revoked cert.
+    other.add_trusted_certificate(ca.certificate)
+    assert other.get_certificate(leaf.certificate.get_id()) is None
+    res2 = {}
+    other.find_certificate(leaf.certificate.get_id(),
+                           lambda crt: res2.update(crt=crt))
+    assert c.run_until(lambda: "crt" in res2, 30)
+    assert res2["crt"] is None
