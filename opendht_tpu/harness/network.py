@@ -55,9 +55,24 @@ class DhtNetwork:
         rng = random.Random(self.seed * 10007 + i)
         return i, sock, node_id, rng
 
-    def add_node(self, i: Optional[int] = None, **dht_kwargs) -> Dht:
+    def _host6(self, i: int) -> str:
+        return f"2001:db9::{i + 1:x}"
+
+    def add_node(self, i: Optional[int] = None, family: str = "ipv4",
+                 **dht_kwargs) -> Dht:
+        """Add a node; ``family``: "ipv4", "ipv6", or "dual" — the
+        netns harness's v4/v6 address assignment
+        (ref python/tools/dht/virtual_network_builder.py:61-116).
+        Dual-stack nodes fork every op into per-family searches with a
+        merged done callback (ref src/dht.cpp:1969-2011)."""
         i, sock, node_id, rng = self._node_wiring(i)
-        dht = Dht(sock, None, DhtConfig(node_id=node_id),
+        sock4 = sock if family in ("ipv4", "dual") else None
+        sock6 = None
+        if family in ("ipv6", "dual"):
+            sock6 = self.net.socket(self._host6(i), 4222)
+        if sock4 is None:
+            self.net.unregister(sock.local_addr())
+        dht = Dht(sock4, sock6, DhtConfig(node_id=node_id),
                   scheduler=self.scheduler, rng=rng, **dht_kwargs)
         self.nodes.append(dht)
         return dht
@@ -72,7 +87,11 @@ class DhtNetwork:
         return dht
 
     def addr_of(self, dht: Dht) -> SockAddr:
-        return dht.engine.t4.local_addr()
+        t = dht.engine.t4 or dht.engine.t6
+        return t.local_addr()
+
+    def addr6_of(self, dht: Dht) -> SockAddr:
+        return dht.engine.t6.local_addr()
 
     def bootstrap_all(self, to: int = 0) -> None:
         """Everyone learns about node ``to``."""
@@ -90,23 +109,32 @@ class DhtNetwork:
                     a.insert_node(b.myid, self.addr_of(b))
 
     # -- fault injection (netem / node-kill equivalents) ----------------
+    def _hosts_of(self, dht: Dht) -> List[str]:
+        return [t.local_addr().host
+                for t in (dht.engine.t4, dht.engine.t6) if t is not None]
+
     def kill(self, dht: Dht) -> None:
-        """Partition a node away (the node-kill knob,
+        """Partition a node away on every family (the node-kill knob,
         ref: DhtNetworkSubProcess shutdown_node network.py:50-64)."""
-        self.net.partition(self.addr_of(dht).host, True)
+        for h in self._hosts_of(dht):
+            self.net.partition(h, True)
 
     def revive(self, dht: Dht) -> None:
-        self.net.partition(self.addr_of(dht).host, False)
+        for h in self._hosts_of(dht):
+            self.net.partition(h, False)
 
     def remove_node(self, dht: Dht) -> None:
         """Kill and forget a node (graceful-removal equivalent).
 
-        Shuts the core down and unregisters its socket so removed nodes
-        stop scheduling maintenance against the shared scheduler."""
-        addr = self.addr_of(dht)
+        Shuts the core down and unregisters its sockets so removed
+        nodes stop scheduling maintenance against the shared
+        scheduler."""
+        addrs = [t.local_addr()
+                 for t in (dht.engine.t4, dht.engine.t6) if t is not None]
         self.kill(dht)
         dht.shutdown()
-        self.net.unregister(addr)
+        for a in addrs:
+            self.net.unregister(a)
         self.nodes.remove(dht)
 
     def replace_cluster(self, count: Optional[int] = None,

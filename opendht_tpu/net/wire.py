@@ -209,14 +209,19 @@ def parse_message(data: bytes) -> ParsedMessage:
     return m
 
 
+# The ``w`` array carries the reference build platform's OS constants
+# (Linux AF_INET=2 / AF_INET6=10, ref src/network_engine.cpp:705-709) —
+# NOT our internal SockAddr family tags.
+WIRE_AF_INET = 2
+WIRE_AF_INET6 = 10
+
+
 def pack_want(want: int) -> list:
-    """``w`` travels as an array of OS address-family constants
-    (AF_INET=2 / AF_INET6=10, ref src/network_engine.cpp:705-709)."""
     out = []
     if want & WANT4:
-        out.append(AF_INET)
+        out.append(WIRE_AF_INET)
     if want & WANT6:
-        out.append(AF_INET6)
+        out.append(WIRE_AF_INET6)
     return out
 
 
@@ -225,9 +230,9 @@ def unpack_want(obj) -> int:
         return obj
     w = 0
     for af in obj or []:
-        if af == AF_INET:
+        if af == WIRE_AF_INET:
             w |= WANT4
-        elif af == AF_INET6:
+        elif af == WIRE_AF_INET6:
             w |= WANT6
     return w
 

@@ -95,7 +95,7 @@ class TestQueryRpcs:
             + mstr("a") + mmap(3)
             + mstr("id") + mbin(bytes(MYID))
             + mstr("target") + mbin(bytes(TARGET))
-            + mstr("w") + marr(2) + mint(AF_INET) + mint(AF_INET6)
+            + mstr("w") + marr(2) + mint(2) + mint(10)  # Linux AF_INET{,6}
             + mstr("q") + mstr("find")
             + envelope_tail(tid, "q"))
         assert self.b.find_node(tid, TARGET, WANT4 | WANT6) == expect
@@ -115,7 +115,7 @@ class TestQueryRpcs:
             + mstr("id") + mbin(bytes(MYID))
             + mstr("h") + mbin(bytes(TARGET))
             + mstr("q") + packed_query
-            + mstr("w") + marr(1) + mint(AF_INET)
+            + mstr("w") + marr(1) + mint(2)
             + mstr("q") + mstr("get")
             + envelope_tail(tid, "q"))
         assert self.b.get_values(tid, TARGET, q, WANT4) == expect
