@@ -33,6 +33,7 @@ maintainRxBuffer :1433-1482).
 
 from __future__ import annotations
 
+import ipaddress
 import os
 import random
 from typing import Callable, Dict, List, Optional, Tuple
@@ -156,7 +157,8 @@ class NetworkEngine:
         self._sock_seq = self.rng.randrange(1 << 16)
 
         self.rate_limiter = make_rate_limiter(MAX_REQUESTS_PER_SEC)
-        self.ip_limiters: Dict[str, RateLimiter] = {}
+        # Keyed by host string (IPv4) or 8-byte packed /64 prefix (IPv6).
+        self.ip_limiters: Dict[object, RateLimiter] = {}
         self.blacklist: Dict[SockAddr, float] = {}
 
         self.partial_messages: Dict[bytes, PartialMessage] = {}
@@ -425,8 +427,13 @@ class NetworkEngine:
     def _rate_limit_ok(self, addr: SockAddr, now: float) -> bool:
         key = addr.host
         if addr.family == AF_INET6 and ":" in key:
-            # group IPv6 by /64 (ref: network_engine.h:572-599)
-            key = ":".join(key.split(":")[:4])
+            # Group IPv6 by /64 (ref: network_engine.h:572-599).  The
+            # textual form may be compressed ("2001:db9::5"), so take
+            # the first 8 of the 16 packed bytes, not string hextets.
+            try:
+                key = ipaddress.ip_address(key.split("%")[0]).packed[:8]
+            except ValueError:
+                key = ":".join(key.split(":")[:4])
         lim = self.ip_limiters.get(key)
         if lim is None:
             lim = self.ip_limiters[key] = make_rate_limiter(

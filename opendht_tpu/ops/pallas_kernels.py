@@ -170,6 +170,15 @@ def nearest_k_ids(ids: jax.Array, targets: jax.Array, k: int = 8, *,
         interpret = jax.default_backend() != "tpu"
     n, l = ids.shape[0], targets.shape[0]
     kb = -(-max(k + margin, 8) // 8) * 8  # sublane-aligned shortlist
+    # Scoped-VMEM budget: the kernel's live set is dominated by a
+    # handful of [tile_l, tile_n] i32 streaming temporaries whose live
+    # ranges grow with the kb unrolled extraction rounds (measured on
+    # v5e: kb=16 @ 64x8192 fits the 16 MB scoped limit, kb=32 @ 64x8192
+    # allocates 21.2 MB and fails to compile).  Shrink tile_n as kb
+    # grows past 16 so tile_l*tile_n*kb stays at or below the known-good
+    # product; lane-align to 512.
+    if kb > 16:
+        tile_n = max(512, (tile_n * 16 // kb) // 512 * 512)
 
     # Nodes limb-major [8, N]; targets limb-minor [L, 8].  Padded node
     # entries are masked inside the kernel by global index (>= n_real),

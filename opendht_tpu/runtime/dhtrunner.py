@@ -45,6 +45,11 @@ from ..utils.logger import NONE, Logger
 from ..utils.sockaddr import AF_INET, AF_INET6, SockAddr
 
 BOOTSTRAP_PERIOD = 10.0  # s, ref: dhtrunner.h:365
+# After this many fruitless retry rounds the runner "gives up": the
+# normal-op gate opens (ref semantics "Connected-or-gave-up",
+# dhtrunner.cpp:316-317) so queued ops run against the empty table and
+# their done-callbacks fire with ok=False instead of hanging forever.
+BOOTSTRAP_MAX_TRIES = 6
 
 
 class DhtRunnerConfig:
@@ -76,6 +81,8 @@ class DhtRunner:
         self._bootstrap_nodes: List[Tuple[str, int]] = []
         self._bootstrapping = False
         self._bootstrap_job = None
+        self._bootstrap_tries = 0
+        self._bootstrap_gen = 0
 
         self.on_status_changed: Optional[Callable[[str, str], None]] = None
         self._status4 = NodeStatus.Disconnected
@@ -275,16 +282,39 @@ class DhtRunner:
         self._post(op, prio=True)
 
     def _try_bootstrap_continuously(self) -> None:
-        """ref: tryBootstrapCoutinuously dhtrunner.cpp:620-677."""
+        """ref: tryBootstrapCoutinuously dhtrunner.cpp:620-677.
+
+        Unlike the reference (which retries forever), after
+        ``BOOTSTRAP_MAX_TRIES`` fruitless rounds the runner gives up:
+        ``_bootstrapping`` clears, which opens the normal-op gate in
+        :meth:`loop`, so queued ops (and their futures) complete with
+        failure instead of hanging on an unreachable bootstrap."""
         if self._bootstrapping or not self._bootstrap_nodes:
             return
         self._bootstrapping = True
+        self._bootstrap_tries = 0
+        # Generation token: a connect→disconnect cycle can leave the old
+        # chain's scheduled job pending; without this it would keep
+        # running alongside the new chain, double-counting tries.
+        self._bootstrap_gen += 1
+        gen = self._bootstrap_gen
+        if self._bootstrap_job is not None:
+            self._bootstrap_job.cancel()
 
         def retry():
-            if not self._bootstrapping or not self._running:
+            if (gen != self._bootstrap_gen or not self._bootstrapping
+                    or not self._running):
                 return
             if self.get_status() == NodeStatus.Connected:
                 self._bootstrapping = False
+                return
+            self._bootstrap_tries += 1
+            if self._bootstrap_tries > BOOTSTRAP_MAX_TRIES:
+                # Give up: release the gate and wake the loop so gated
+                # ops run now (they will fail fast on the empty table).
+                self._bootstrapping = False
+                with self._cv:
+                    self._cv.notify_all()
                 return
             # most recently added first
             for host, port in reversed(self._bootstrap_nodes):

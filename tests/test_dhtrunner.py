@@ -67,3 +67,24 @@ def test_shutdown_and_join(pair):
     assert wait_for(lambda: done, 10)
     a.join()
     assert not a._thread
+
+
+def test_bootstrap_gives_up_and_releases_ops(monkeypatch):
+    """With an unreachable bootstrap, queued ops must not hang forever:
+    after BOOTSTRAP_MAX_TRIES fruitless rounds the gate opens and the
+    get future completes (ref gate: dhtrunner.cpp:316-317)."""
+    from opendht_tpu.runtime import dhtrunner as dr_mod
+
+    monkeypatch.setattr(dr_mod, "BOOTSTRAP_PERIOD", 0.05)
+    monkeypatch.setattr(dr_mod, "BOOTSTRAP_MAX_TRIES", 3)
+    r = DhtRunner()
+    r.run(port=0, bind4="127.0.0.1")
+    try:
+        # Nobody listens on this port; pings are never answered.
+        r.bootstrap("127.0.0.1", 1)
+        fut = r.get_future(InfoHash.get("unreachable"))
+        vals = fut.result(timeout=10)  # must not raise TimeoutError
+        assert vals == []
+        assert not r._bootstrapping
+    finally:
+        r.join()

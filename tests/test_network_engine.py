@@ -249,3 +249,20 @@ def test_stats_counters():
     assert o1.get("ping") == 1
     assert i2.get("ping") == 1
     assert i1.get("reply") == 1
+
+
+def test_rate_limit_ipv6_64_grouping_compressed():
+    """Compressed IPv6 textual forms in the same /64 must share one
+    rate-limit bucket (ref: network_engine.h:572-599)."""
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    now = clk.now()
+    same64 = [SockAddr("2001:db9::5", 4222),
+              SockAddr("2001:db9:0:0:1::7", 4222),
+              SockAddr("2001:0db9:0000:0000:aaaa::1", 4222)]
+    other64 = SockAddr("2001:db9:0:1::5", 4222)
+    for a in same64:
+        assert e1._rate_limit_ok(a, now)
+    assert e1._rate_limit_ok(other64, now)
+    # Three compressed spellings of one /64 -> one limiter; the
+    # different /64 gets its own.
+    assert len(e1.ip_limiters) == 2
