@@ -33,7 +33,9 @@ REFERENCE_LOOKUPS_PER_SEC = 140.0
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1_000_000)
-    ap.add_argument("--lookups", type=int, default=100_000)
+    ap.add_argument("--lookups", type=int, default=1_000_000)
+    ap.add_argument("--puts", type=int, default=100_000,
+                    help="announce/get batch for --mode putget")
     ap.add_argument("--repeat", type=int, default=3)
     ap.add_argument("--recall-sample", type=int, default=512)
     ap.add_argument("--mode", choices=("lookups", "putget"),
@@ -46,32 +48,38 @@ def main():
         return putget_main(args)
 
     from opendht_tpu.models.swarm import (
-        SwarmConfig, build_swarm, lookup_compact, true_closest,
+        SwarmConfig, build_swarm, lookup, true_closest,
     )
 
     cfg = SwarmConfig.for_nodes(args.nodes)
     key = jax.random.PRNGKey(0)
     swarm = build_swarm(key, cfg)
-    jax.block_until_ready(swarm.tables)
+    _ = np.asarray(swarm.tables[:1, :1])   # force build
 
     targets = jax.random.bits(jax.random.PRNGKey(1), (args.lookups, 5),
                               jnp.uint32)
 
-    # Warmup (compile — covers the power-of-two compaction sizes too).
-    res = lookup_compact(swarm, cfg, targets, jax.random.PRNGKey(2))
-    jax.block_until_ready(res.found)
+    def sync(res):
+        # A value fetch is the only reliable completion barrier in the
+        # remote-tunnel dev environment (block_until_ready can return
+        # before remote execution finishes); an 8-byte scalar that
+        # depends on the full result forces it without paying the
+        # multi-MB array transfer inside the timed region.
+        return int(np.asarray(jnp.sum(res.found[:, 0])))
+
+    res = lookup(swarm, cfg, targets, jax.random.PRNGKey(2))  # warmup
+    sync(res)
 
     if args.profile:
         with jax.profiler.trace(args.profile):
-            res = lookup_compact(swarm, cfg, targets,
-                                 jax.random.PRNGKey(99))
-            jax.block_until_ready(res.found)
+            res = lookup(swarm, cfg, targets, jax.random.PRNGKey(99))
+            sync(res)
 
     times = []
     for r in range(args.repeat):
         t0 = time.perf_counter()
-        res = lookup_compact(swarm, cfg, targets, jax.random.PRNGKey(3 + r))
-        jax.block_until_ready(res.found)
+        res = lookup(swarm, cfg, targets, jax.random.PRNGKey(3 + r))
+        sync(res)
         times.append(time.perf_counter() - t0)
     dt = min(times)
     lps = args.lookups / dt
@@ -130,7 +138,7 @@ def putget_main(args):
                        max_listeners=1 << 10)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
     jax.block_until_ready(swarm.tables)
-    p = args.lookups
+    p = args.puts
     keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
     vals = jnp.arange(p, dtype=jnp.uint32) + 1
     seqs = jnp.ones((p,), jnp.uint32)

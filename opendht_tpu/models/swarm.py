@@ -45,9 +45,9 @@ import jax.numpy as jnp
 from ..ops.xor_metric import (
     N_LIMBS,
     closest_nodes_batched,
-    common_bits,
     lex_searchsorted,
-    merge_shortlists_dist,
+    merge_shortlists_d0,
+    prefix_len32,
 )
 
 UINT32_MAX = 0xFFFFFFFF
@@ -67,31 +67,57 @@ class SwarmConfig(NamedTuple):
     alpha: int = 4
     quorum: int = 8
     max_steps: int = 48
+    # Augment routing tables with their members' first id limbs
+    # ([N,B,K] uint32 alongside the index table).  TPU random gathers
+    # cost ~10 ns per *fetch* regardless of row width (measured v5e),
+    # so shipping each member's distance surrogate inside the already-
+    # fetched bucket row removes the dominant per-step gather (64
+    # scalar fetches/lookup → 0).  Costs one extra tables-sized array —
+    # for_nodes turns it off above 2M nodes where HBM gets tight.
+    aug_tables: bool = True
 
     @classmethod
     def for_nodes(cls, n_nodes: int, **kw) -> "SwarmConfig":
-        # Enough buckets that the deepest one holds ~2·K nodes.
-        b = max(4, int(math.ceil(math.log2(max(16, n_nodes)))) - 3)
+        # Enough buckets that the deepest one holds ~2·K nodes.  Capped
+        # at 32: the hot path derives bucket indices from first-limb
+        # prefix lengths (common_bits32), exact up to that depth — and
+        # 2^35 nodes would be needed to want more.
+        b = min(32, max(4, int(math.ceil(math.log2(max(16, n_nodes)))) - 3))
+        kw.setdefault("aug_tables", n_nodes <= 2_000_000)
         return cls(n_nodes=n_nodes, n_buckets=b, **kw)
 
 
 class Swarm(NamedTuple):
-    """Device-resident swarm state (a pytree of arrays)."""
+    """Device-resident swarm state (a pytree of arrays).
+
+    ``tables`` layout depends on ``SwarmConfig.aug_tables``:
+
+    * augmented (default): ``[N,B,2K] int32`` — per bucket row, the K
+      member indices followed by the K members' first id limbs
+      (uint32, bitcast to int32).  One fetch brings a candidate list
+      *and* its distance surrogates — see SwarmConfig.aug_tables.
+    * plain: ``[N,B,K] int32`` member indices only (-1 = empty).
+    """
     ids: jax.Array     # [N,5] uint32, lexicographically sorted
-    tables: jax.Array  # [N,B,K] int32 indices into ids; -1 = empty
+    tables: jax.Array  # [N,B,K or 2K] int32 — see class docstring
     alive: jax.Array   # [N] bool
 
 
 class LookupState(NamedTuple):
     """Lock-step batched lookup state (all ``[L, ...]``).
 
-    The shortlist carries XOR *distances* rather than ids: since
-    ``dist = id ^ target`` is a bijection per lookup, ids are
-    recoverable on demand and never ride through the sort hot path.
+    The shortlist carries only the first 32 bits of the XOR distance
+    (``dist = limb0(id ^ target)``): that surrogate decides the
+    per-round merge order (exact up to ~2^-33 d0 collisions per merge
+    — see :func:`opendht_tpu.ops.xor_metric.merge_shortlists_d0`),
+    while the final result is re-sorted by the exact 160-bit distance
+    once per lookup (:func:`_finalize`).  Keeping the hot-loop state
+    free of ``[..., 5]``-minor arrays is what lets every per-round op
+    tile fully onto TPU lanes.
     """
     targets: jax.Array  # [L,5]
     idx: jax.Array      # [L,S] shortlist node indices, sorted by dist
-    dist: jax.Array     # [L,S,5] xor distance to target (sentinel=all-1)
+    dist: jax.Array     # [L,S] uint32 first-limb xor distance (~0=empty)
     queried: jax.Array  # [L,S] bool
     done: jax.Array     # [L] bool
     hops: jax.Array     # [L] int32 — solicitation rounds until sync
@@ -192,6 +218,10 @@ def build_swarm(key: jax.Array, cfg: SwarmConfig) -> Swarm:
 
     tables = jax.lax.map(one_bucket, jnp.arange(b_total))  # [B,N,K]
     tables = jnp.transpose(tables, (1, 0, 2))
+    if cfg.aug_tables:
+        m0 = jax.lax.bitcast_convert_type(
+            ids[:, 0][jnp.clip(tables, 0, n - 1)], jnp.int32)
+        tables = jnp.concatenate([tables, m0], axis=-1)    # [N,B,2K]
     return Swarm(ids=ids, tables=tables, alive=jnp.ones((n,), bool))
 
 
@@ -213,42 +243,72 @@ def churn(swarm: Swarm, key: jax.Array, kill_frac: float,
 # ---------------------------------------------------------------------------
 
 def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
-             nid: jax.Array):
+             nid: jax.Array, nid_d0: jax.Array):
     """What each solicited node returns for each target.
 
-    ``targets``: ``[L,5]``; ``nid``: ``[L,A]`` node indices (-1 = none).
-    Returns ``(resp [L, A*2K], answered [L,A])``: candidate indices —
-    the solicited node's bucket ``c = commonBits(self, target)`` (every
-    member is strictly closer to the target than the node itself) plus
-    bucket ``c+1`` — together the node's best approximation of "the 8
-    closest I know" (``Dht::onFindNode`` src/dht.cpp:3189-3200).  Dead
-    or empty slots return -1s.  ``answered`` is the delivery mask: the
-    local engine always delivers to live targets; the sharded transport
-    may drop over-capacity queries (they retry next round).
+    ``targets``: ``[L,5]``; ``nid``: ``[L,A]`` node indices (-1 =
+    none); ``nid_d0``: ``[L,A]`` the solicited nodes' first-limb XOR
+    distance to the target — already in the caller's shortlist state,
+    so the bucket index ``c = clz(d0)`` (= ``commonBits(self,
+    target)``, exact for n_buckets ≤ 32) costs no gather at all.
+
+    Returns ``(resp [L,A*2K], resp_d0 [L,A*2K], answered [L,A])``:
+    candidate indices and their first-limb distances — the solicited
+    node's bucket ``c`` (every member strictly closer to the target
+    than the node itself) plus bucket ``c+1``, the node's best
+    approximation of "the 8 closest I know" (``Dht::onFindNode``
+    src/dht.cpp:3189-3200).  With augmented tables the distances ride
+    inside the bucket-row fetches (members' first limbs XOR the
+    target); otherwise they come from a per-candidate id gather — the
+    slow path, kept for swarms too big to afford the aug table.  Dead
+    or empty slots return -1 / all-ones.  ``answered`` is the delivery
+    mask: the local engine always delivers to live targets; the
+    sharded transport may drop over-capacity queries (they retry next
+    round).
     """
     n, b_total, k = cfg.n_nodes, cfg.n_buckets, cfg.bucket_k
+    l = targets.shape[0]
     safe = jnp.clip(nid, 0, n - 1)
-    nid_ids = swarm.ids[safe]                                   # [L,A,5]
-    c = common_bits(nid_ids, targets[:, None, :])               # [L,A]
+    c = prefix_len32(nid_d0)                                    # [L,A]
     c0 = jnp.clip(c, 0, b_total - 1)
     c1 = jnp.clip(c + 1, 0, b_total - 1)
-    rows0 = swarm.tables[safe, c0]                              # [L,A,K]
+    rows0 = swarm.tables[safe, c0]                          # [L,A,K|2K]
     rows1 = swarm.tables[safe, c1]
-    resp = jnp.concatenate([rows0, rows1], axis=-1)             # [L,A,2K]
     ok = (nid >= 0) & swarm.alive[safe]
-    resp = jnp.where(ok[..., None], resp, -1)
-    return resp.reshape(resp.shape[0], -1), ok
+    if swarm.tables.shape[-1] == 2 * k:                     # augmented
+        resp = jnp.concatenate([rows0[..., :k], rows1[..., :k]],
+                               axis=-1)
+        resp = jnp.where(ok[..., None], resp, -1).reshape(l, -1)
+        m0 = jax.lax.bitcast_convert_type(
+            jnp.concatenate([rows0[..., k:], rows1[..., k:]], axis=-1),
+            jnp.uint32)
+        d0 = m0.reshape(l, -1) ^ targets[:, 0][:, None]
+        d0 = jnp.where(resp < 0, jnp.uint32(UINT32_MAX), d0)
+    else:
+        resp = jnp.concatenate([rows0, rows1], axis=-1)     # [L,A,2K]
+        resp = jnp.where(ok[..., None], resp, -1).reshape(l, -1)
+        d0 = _resp_dist(swarm.ids, cfg, targets, resp)
+    return resp, d0, ok
 
 
-def _select_alpha(st: LookupState, cfg: SwarmConfig) -> jax.Array:
-    """Indices of the α best unqueried shortlist nodes per lookup."""
+def _select_alpha(st: LookupState, cfg: SwarmConfig):
+    """α best unqueried shortlist nodes per lookup, with their d0.
+
+    The shortlist is already distance-sorted, so the α best unqueried
+    are the first α unqueried slots; each is extracted with one masked
+    reduction (at most one slot per row has rank j), which beats a
+    sort for α ≪ S.  Returns ``(sel [L,A] int32, sel_d0 [L,A])`` —
+    the d0 rides along so responders can derive their bucket index
+    without touching the id matrix.
+    """
     unq = (st.idx >= 0) & ~st.queried
     order = jnp.cumsum(unq.astype(jnp.int32), axis=1)
-    key = jnp.where(unq & (order <= cfg.alpha), order,
-                    jnp.int32(cfg.search_width + 1))
-    skey, sidx = jax.lax.sort((key, st.idx), dimension=1, num_keys=1)
-    return jnp.where(skey[:, :cfg.alpha] > cfg.search_width, -1,
-                     sidx[:, :cfg.alpha])
+    sel, sel_d0 = [], []
+    for j in range(cfg.alpha):
+        m = unq & (order == j + 1)
+        sel.append(jnp.max(jnp.where(m, st.idx, -1), axis=1))
+        sel_d0.append(jnp.max(jnp.where(m, st.dist, 0), axis=1))
+    return jnp.stack(sel, axis=1), jnp.stack(sel_d0, axis=1)
 
 
 def _sync_done(st_idx: jax.Array, st_queried: jax.Array,
@@ -266,19 +326,22 @@ def init_impl(ids: jax.Array, respond, cfg: SwarmConfig,
     own routing table — the reference's search creation consulting
     local buckets (``Dht::search`` src/dht.cpp:1672-1735).
 
-    ``respond(targets, nid)`` abstracts where routing tables live:
-    local gathers (single chip) or the all_to_all routed exchange
-    (:mod:`opendht_tpu.parallel.sharded`).
+    ``respond(targets, nid, nid_d0)`` abstracts where routing tables
+    live: local gathers (single chip) or the all_to_all routed
+    exchange (:mod:`opendht_tpu.parallel.sharded`).
     """
     l = targets.shape[0]
     s = cfg.search_width
-    resp, _ = respond(targets, origins[:, None])      # [L,2K]
-    cand_idx = jnp.concatenate(
-        [resp, jnp.full((l, max(0, s - resp.shape[1])), -1, jnp.int32)],
-        axis=1) if resp.shape[1] < s else resp
-    cand_dist = _resp_dist(ids, cfg, targets, cand_idx)
-    f_idx, f_dist, f_q = merge_shortlists_dist(
-        cand_dist, cand_idx, jnp.zeros_like(cand_idx, bool), keep=s)
+    o_d0 = ids[:, 0][origins] ^ targets[:, 0]         # [L]
+    resp, resp_d0, _ = respond(targets, origins[:, None], o_d0[:, None])
+    pad = max(0, s - resp.shape[1])
+    if pad:
+        resp = jnp.concatenate(
+            [resp, jnp.full((l, pad), -1, jnp.int32)], axis=1)
+        resp_d0 = jnp.concatenate(
+            [resp_d0, jnp.full((l, pad), UINT32_MAX, jnp.uint32)], axis=1)
+    f_idx, f_dist, f_q = merge_shortlists_d0(
+        resp_d0, resp, jnp.zeros_like(resp, bool), keep=s)
     return LookupState(
         targets=targets, idx=f_idx, dist=f_dist, queried=f_q,
         done=jnp.zeros((l,), bool), hops=jnp.zeros((l,), jnp.int32))
@@ -292,9 +355,10 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     # Finished lookups stop soliciting: besides wasting gathers, their
     # traffic would consume bounded all_to_all capacity and could
     # starve still-active queries on a hot shard.
-    sel = jnp.where(st.done[:, None], -1, _select_alpha(st, cfg))  # [L,A]
+    sel, sel_d0 = _select_alpha(st, cfg)                        # [L,A]
+    sel = jnp.where(st.done[:, None], -1, sel)
     sel_alive = (sel >= 0) & alive[jnp.clip(sel, 0, cfg.n_nodes - 1)]
-    resp, answered = respond(st.targets, sel)                   # [L,A*2K]
+    resp, resp_d0, answered = respond(st.targets, sel, sel_d0)  # [L,A*2K]
     hit = st.idx[:, :, None] == sel[:, None, :]                 # [L,S,A]
     hit = hit & (sel[:, None, :] >= 0)
     # Answered solicitations become "queried"; dead nodes are evicted
@@ -309,13 +373,11 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     cand_idx = jnp.concatenate([idx, resp], axis=1)
     # Evicted frontier slots must not keep their old (now invalid)
     # distance keys.
-    fr_dist = jnp.where(evict[..., None], jnp.uint32(UINT32_MAX),
-                        st.dist)
-    cand_dist = jnp.concatenate(
-        [fr_dist, _resp_dist(ids, cfg, st.targets, resp)], axis=1)
+    fr_dist = jnp.where(evict, jnp.uint32(UINT32_MAX), st.dist)
+    cand_dist = jnp.concatenate([fr_dist, resp_d0], axis=1)
     cand_q = jnp.concatenate(
         [queried, jnp.zeros_like(resp, bool)], axis=1)
-    f_idx, f_dist, f_q = merge_shortlists_dist(
+    f_idx, f_dist, f_q = merge_shortlists_d0(
         cand_dist, cand_idx, cand_q, keep=cfg.search_width)
 
     active = ~st.done & jnp.any(sel >= 0, axis=1)
@@ -324,7 +386,7 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     return LookupState(
         targets=st.targets,
         idx=jnp.where(st.done[:, None], st.idx, f_idx),
-        dist=jnp.where(st.done[:, None, None], st.dist, f_dist),
+        dist=jnp.where(st.done[:, None], st.dist, f_dist),
         queried=jnp.where(st.done[:, None], st.queried, f_q),
         done=done,
         hops=st.hops + active.astype(jnp.int32))
@@ -332,14 +394,14 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
 
 def _resp_dist(ids: jax.Array, cfg: SwarmConfig, targets: jax.Array,
                cand_idx: jax.Array) -> jax.Array:
-    """XOR distance limbs for candidate indices (sentinel where -1)."""
-    cand_ids = ids[jnp.clip(cand_idx, 0, cfg.n_nodes - 1)]
-    d = jnp.bitwise_xor(cand_ids, targets[:, None, :])
-    return jnp.where((cand_idx < 0)[..., None], jnp.uint32(UINT32_MAX), d)
+    """First-limb XOR distance for candidate indices (~0 where -1)."""
+    cand_ids0 = ids[:, 0][jnp.clip(cand_idx, 0, cfg.n_nodes - 1)]
+    d0 = jnp.bitwise_xor(cand_ids0, targets[:, 0][:, None])
+    return jnp.where(cand_idx < 0, jnp.uint32(UINT32_MAX), d0)
 
 
 def _local_respond(swarm: Swarm, cfg: SwarmConfig):
-    return lambda tg, nid: _respond(swarm, cfg, tg, nid)
+    return lambda tg, nid, nid_d0: _respond(swarm, cfg, tg, nid, nid_d0)
 
 
 @partial(jax.jit, static_argnames=("l",))
@@ -392,9 +454,8 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         return ~jnp.all(st.done) & (jnp.max(st.hops) < cfg.max_steps)
 
     st = jax.lax.while_loop(cond, lambda s: lookup_step(swarm, cfg, s), st)
-    found = jnp.where(st.queried[:, :cfg.quorum],
-                      st.idx[:, :cfg.quorum], -1)
-    return LookupResult(found=found, hops=st.hops, done=st.done)
+    return LookupResult(found=_finalize(swarm.ids, st, cfg),
+                        hops=st.hops, done=st.done)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_steps"))
@@ -405,9 +466,25 @@ def lookup_steps(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
         0, n_steps, lambda _, s: lookup_step(swarm, cfg, s), st)
 
 
-def _finalize(st: LookupState, cfg: SwarmConfig) -> jax.Array:
-    return jnp.where(st.queried[:, :cfg.quorum], st.idx[:, :cfg.quorum],
-                     -1)
+@partial(jax.jit, static_argnames=("cfg",))
+def _finalize(ids: jax.Array, st: LookupState,
+              cfg: SwarmConfig) -> jax.Array:
+    """Exact-order result extraction, once per lookup.
+
+    The hot loop orders the shortlist by the 32-bit surrogate; here the
+    S=14 survivors are re-sorted by the full 160-bit distance (one
+    small gather + one [L,S] sort), so the reported top-``quorum`` is
+    exactly XOR-ordered regardless of surrogate ties.
+    """
+    n = ids.shape[0]
+    cand = ids[jnp.clip(st.idx, 0, n - 1)]                  # [L,S,5]
+    d = jnp.bitwise_xor(cand, st.targets[:, None, :])
+    d = jnp.where((st.idx < 0)[..., None], jnp.uint32(UINT32_MAX), d)
+    keys = tuple(d[..., i] for i in range(N_LIMBS))
+    out = jax.lax.sort(keys + (st.idx, st.queried), dimension=1,
+                       num_keys=N_LIMBS)
+    f_idx, f_q = out[N_LIMBS], out[N_LIMBS + 1]
+    return jnp.where(f_q[:, :cfg.quorum], f_idx[:, :cfg.quorum], -1)
 
 
 def lookup_compact(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
@@ -440,7 +517,7 @@ def lookup_compact(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         finished = (done | (total >= cfg.max_steps)) & live
         if finished.any():
             rows = idx_map[finished]
-            f = np.asarray(_finalize(st, cfg))
+            f = np.asarray(_finalize(swarm.ids, st, cfg))
             found[rows] = f[finished]
             hops[rows] = np.asarray(st.hops)[finished]
             done_out[rows] = done[finished]

@@ -45,6 +45,29 @@ def xor_ids(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.bitwise_xor(a, b)
 
 
+def prefix_len32(d0: jax.Array) -> jax.Array:
+    """Leading-zero count of a first-limb XOR distance, 32 where zero.
+
+    ``d0 = limb0(a ^ b)`` ⇒ this is the common-prefix length of a and
+    b capped at 32 — exact whenever the true common prefix is < 32
+    bits, always the case for distinct uniform ids below ~2^32 nodes.
+    The lookup hot path uses it to derive bucket indices from
+    distances it already holds, with no id gather.
+    """
+    return jnp.where(d0 == 0, jnp.int32(32),
+                     jax.lax.clz(d0).astype(jnp.int32))
+
+
+def common_bits32(a0: jax.Array, b0: jax.Array) -> jax.Array:
+    """Common-prefix length from the *first limbs only*, capped at 32.
+
+    Callers that clip the result to a bucket count ≤ 32
+    (``SwarmConfig.n_buckets``) get the same answer as
+    :func:`common_bits` from 1/5 of the gather traffic.
+    """
+    return prefix_len32(jnp.bitwise_xor(a0, b0))
+
+
 def common_bits(a: jax.Array, b: jax.Array) -> jax.Array:
     """Length of the common bit-prefix of two packed ids.
 
@@ -218,44 +241,51 @@ def lex_searchsorted(sorted_ids: jax.Array, queries: jax.Array,
     return lo
 
 
-def merge_shortlists_dist(cand_dist: jax.Array, cand_idx: jax.Array,
-                          cand_queried: jax.Array, keep: int
-                          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Distance-space merge + dedup, XOR-sorted, fixed width.
+def merge_shortlists_d0(cand_d0: jax.Array, cand_idx: jax.Array,
+                        cand_queried: jax.Array, keep: int
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Surrogate-distance merge + dedup for the lookup hot loop.
 
-    Like :func:`merge_shortlists` but candidates arrive as XOR-distance
-    limbs (``dist = id ^ target``) rather than ids — the bijection means
-    ids never need to ride through the sorts, cutting the operand count
-    nearly in half on the lookup hot path.  Invalid slots (idx < 0) must
-    already carry all-ones distance.
+    Candidates carry only the first 32 XOR-distance bits
+    (``d0 = limb0(id ^ target)``); order is ``(d0, idx)``.  For uniform
+    ids two *distinct* shortlist candidates collide on d0 with
+    probability ≈ C²/2³³ per merge, so the order differs from the exact
+    160-bit order (``Search::insertNode``, src/dht.cpp:961-1047)
+    immeasurably rarely, and the final result is re-sorted exactly once
+    per lookup (``models.swarm._finalize``).  What IS exact here is the
+    dedup — same node ⇔ same index, so duplicates are found by ``idx``
+    equality, with queried copies winning.
 
-    Returns ``(idx [L,keep], dist [L,keep,5], queried [L,keep])``.
+    The payoff vs the former 5-limb merge: no ``[..., 5]``-minor arrays
+    (which tile onto TPU lanes at 5/128 utilisation) and 2 sorts of 3-4
+    operands instead of 8.  Invalid slots (idx < 0) must carry all-ones
+    ``d0``.
+
+    Returns ``(idx [L,keep], d0 [L,keep], queried [L,keep])``.
     """
-    invalid = cand_idx < 0
-    dist_m = jnp.where(invalid[..., None], SENTINEL_LIMB, cand_dist)
-    keys = tuple(dist_m[..., i] for i in range(N_LIMBS))
-    # Among equal distances (same id), queried copies sort first so the
-    # dedup pass keeps the queried flag.
+    maxu = jnp.uint32(0xFFFFFFFF)
+    d0 = jnp.where(cand_idx < 0, maxu, cand_d0)
+    # -1 becomes 0xFFFFFFFF and sorts last among equal d0; bitcast back
+    # below recovers the int32 index for free.
+    idx_u = cand_idx.astype(jnp.uint32)
     inv_q = (~cand_queried).astype(jnp.uint32)
-    out = jax.lax.sort(keys + (inv_q, cand_idx, cand_queried),
-                       dimension=1, num_keys=N_LIMBS + 1, is_stable=True)
-    s_keys = jnp.stack(out[:N_LIMBS], axis=-1)
-    s_idx, s_q = out[N_LIMBS + 1], out[N_LIMBS + 2]
+    s_d0, s_idx_u, _, s_q = jax.lax.sort(
+        (d0, idx_u, inv_q, cand_queried), dimension=1, num_keys=3,
+        is_stable=False)
+    s_idx = s_idx_u.astype(jnp.int32)
 
-    prev = jnp.roll(s_keys, 1, axis=1)
-    dup = jnp.all(s_keys == prev, axis=-1)
+    prev = jnp.roll(s_idx, 1, axis=1)
+    dup = s_idx == prev
     dup = dup.at[:, 0].set(False)
     dup = dup | (s_idx < 0)
     s_idx = jnp.where(dup, -1, s_idx)
-    keys2 = tuple(jnp.where(dup, SENTINEL_LIMB, s_keys[..., i])
-                  for i in range(N_LIMBS))
-    out2 = jax.lax.sort(
-        keys2 + (dup.astype(jnp.uint32), s_idx, s_q),
-        dimension=1, num_keys=N_LIMBS + 1, is_stable=True)
-    f_dist = jnp.stack(out2[:N_LIMBS], axis=-1)
-    f_idx, f_q = out2[N_LIMBS + 1], out2[N_LIMBS + 2]
+    d0_2 = jnp.where(dup, maxu, s_d0)
+    f_d0, f_idx_u, f_q = jax.lax.sort(
+        (d0_2, jnp.where(dup, maxu, s_idx_u), s_q), dimension=1,
+        num_keys=1, is_stable=True)
+    f_idx = f_idx_u.astype(jnp.int32)
     f_q = f_q & (f_idx >= 0)
-    return f_idx[:, :keep], f_dist[:, :keep], f_q[:, :keep]
+    return f_idx[:, :keep], f_d0[:, :keep], f_q[:, :keep]
 
 
 def merge_shortlists(target: jax.Array, cand_ids: jax.Array,

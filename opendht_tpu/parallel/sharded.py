@@ -34,11 +34,12 @@ from ..models.swarm import (
     LookupResult,
     Swarm,
     SwarmConfig,
+    _finalize,
     init_impl,
     lookup,
     step_impl,
 )
-from ..ops.xor_metric import common_bits
+from ..ops.xor_metric import prefix_len32
 from .mesh import AXIS
 
 
@@ -59,21 +60,23 @@ def data_parallel_lookup(swarm: Swarm, cfg: SwarmConfig,
 
 def _route_respond(tables_local: jax.Array, ids: jax.Array,
                    alive: jax.Array, targets: jax.Array, nid: jax.Array,
-                   cfg: SwarmConfig, n_shards: int,
+                   nid_d0: jax.Array, cfg: SwarmConfig, n_shards: int,
                    capacity_factor: float):
     """Answer solicitations whose routing tables live on other shards.
 
-    ``nid``: ``[Ll, A]`` global node indices (-1 = none).  Returns
-    ``(resp [Ll, A*2K], answered [Ll, A])``.  Queries ship
-    ``(local_row, bucket, bucket+1)`` to the owner shard in
-    fixed-capacity buckets of ``C = capacity_factor · Q/D`` (expected
-    load per shard times head-room — NOT the worst-case Q, which would
-    inflate shuffle traffic D×), are answered by local gathers, and
-    ship back — two ``all_to_all`` per round, O(α·L/D·c) payload each.
-    Queries landing past an owner's capacity are *dropped* this round
-    (``answered`` False): the origin keeps them unqueried and re-sends
-    next round, the lock-step analogue of the reference's request
-    retransmit after timeout (request.h:113).
+    ``nid``: ``[Ll, A]`` global node indices (-1 = none); ``nid_d0``
+    their first-limb XOR distance to the target (from the shortlist
+    state — no id gather).  Returns ``(resp [Ll, A*2K], resp_d0
+    [Ll, A*2K], answered [Ll, A])``.  Queries ship ``(local_row,
+    bucket, bucket+1)`` to the owner shard in fixed-capacity buckets
+    of ``C = capacity_factor · Q/D`` (expected load per shard times
+    head-room — NOT the worst-case Q, which would inflate shuffle
+    traffic D×), are answered by local gathers of the index + member-
+    limb rows, and ship back — two ``all_to_all`` per round,
+    O(α·L/D·c) payload each.  Queries landing past an owner's capacity
+    are *dropped* this round (``answered`` False): the origin keeps
+    them unqueried and re-sends next round, the lock-step analogue of
+    the reference's request retransmit after timeout (request.h:113).
     """
     n = cfg.n_nodes
     shard_n = n // n_shards
@@ -88,9 +91,9 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
     safe = jnp.clip(flat, 0, n - 1)
     ok = (flat >= 0) & alive[safe]
 
-    # Bucket indices computed origin-side from the replicated id matrix.
-    tg = jnp.repeat(targets, a, axis=0)                      # [Q,5]
-    c = common_bits(ids[safe], tg)
+    # Bucket index from the solicited node's own shortlist distance:
+    # c = clz(d0) = commonBits(node, target), exact for n_buckets ≤ 32.
+    c = prefix_len32(nid_d0.reshape(-1))
     c0 = jnp.clip(c, 0, cfg.n_buckets - 1)
     c1 = jnp.clip(c + 1, 0, cfg.n_buckets - 1)
 
@@ -125,18 +128,35 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
     r_c0 = jnp.clip(r_c0, 0, cfg.n_buckets - 1)
     r_c1 = jnp.clip(r_c1, 0, cfg.n_buckets - 1)
 
-    # Owner-side gather of the two bucket rows.
+    # Owner-side gather of the two bucket rows.  Augmented tables
+    # ([.., idx K | m0 K]) ship back as-is; plain tables (swarms too
+    # big to afford the aug copy even when sharded) get the member
+    # limbs from an owner-side id gather — slower, but the id matrix
+    # is replicated, so it stays local.
+    k = cfg.bucket_k
     safe_row = jnp.clip(r_row, 0, shard_n - 1)
-    rows0 = tables_local[safe_row, r_c0]                     # [D,C,K]
+    rows0 = tables_local[safe_row, r_c0]                     # [D,C,K|2K]
     rows1 = tables_local[safe_row, r_c1]
-    resp = jnp.concatenate([rows0, rows1], axis=-1)          # [D,C,2K]
+    if tables_local.shape[-1] == k:                          # plain
+        m0 = jax.lax.bitcast_convert_type(ids[:, 0][jnp.clip(
+            jnp.concatenate([rows0, rows1], axis=-1), 0, n - 1)],
+            jnp.int32)
+        rows0 = jnp.concatenate([rows0, m0[..., :k]], axis=-1)
+        rows1 = jnp.concatenate([rows1, m0[..., k:]], axis=-1)
+    resp = jnp.concatenate([rows0, rows1], axis=-1)          # [D,C,4K]
     resp = jnp.where((r_row >= 0)[..., None], resp, -1)
 
-    back = a2a(resp)                                         # [D,C,2K]
-    mine = back[owner, jnp.clip(pos, 0, cap - 1)]            # [Q,2K]
+    back = a2a(resp)                                         # [D,C,4K]
+    mine = back[owner, jnp.clip(pos, 0, cap - 1)]            # [Q,4K]
     mine = jnp.where(sent[:, None], mine, -1)
-    return (mine.reshape(ll, a * 2 * cfg.bucket_k),
-            sent.reshape(ll, a))
+    r_idx = jnp.concatenate([mine[:, :k], mine[:, 2 * k:3 * k]],
+                            axis=-1).reshape(ll, a * 2 * k)
+    r_m0 = jax.lax.bitcast_convert_type(
+        jnp.concatenate([mine[:, k:2 * k], mine[:, 3 * k:]], axis=-1),
+        jnp.uint32).reshape(ll, a * 2 * k)
+    r_d0 = r_m0 ^ targets[:, 0][:, None]
+    r_d0 = jnp.where(r_idx < 0, jnp.uint32(0xFFFFFFFF), r_d0)
+    return r_idx, r_d0, sent.reshape(ll, a)
 
 
 def _sharded_body(cfg: SwarmConfig, n_shards: int,
@@ -152,17 +172,17 @@ def _sharded_body(cfg: SwarmConfig, n_shards: int,
     from ..models.swarm import _sample_origins
     origins = _sample_origins(key, alive, ll)
 
-    def respond(tg, nid):
-        return _route_respond(tables_local, ids, alive, tg, nid, cfg,
-                              n_shards, capacity_factor)
+    def respond(tg, nid, nid_d0):
+        return _route_respond(tables_local, ids, alive, tg, nid,
+                              nid_d0, cfg, n_shards, capacity_factor)
 
-    def respond_init(tg, nid):
+    def respond_init(tg, nid, nid_d0):
         # The init seed is never re-sent: a capacity drop here would
         # leave the lookup with an empty shortlist → instant
         # exhaustion-done with nothing found.  It is also a one-off
         # [D, Ll, 3] exchange (α=1), so run it uncapped.
-        return _route_respond(tables_local, ids, alive, tg, nid, cfg,
-                              n_shards, float("inf"))
+        return _route_respond(tables_local, ids, alive, tg, nid,
+                              nid_d0, cfg, n_shards, float("inf"))
 
     # Init: origin's own table answers first (hop 0).  The lock-step
     # round logic is the single shared implementation from
@@ -179,9 +199,7 @@ def _sharded_body(cfg: SwarmConfig, n_shards: int,
         return step_impl(ids, alive, respond, cfg, st), it + 1
 
     st, _ = jax.lax.while_loop(cond, body, (st, jnp.int32(0)))
-    found = jnp.where(st.queried[:, :cfg.quorum], st.idx[:, :cfg.quorum],
-                      -1)
-    return found, st.hops, st.done
+    return _finalize(ids, st, cfg), st.hops, st.done
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor"))

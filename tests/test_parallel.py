@@ -80,3 +80,21 @@ def test_sharded_lookup_hot_key_contention(swarm, mesh):
     assert bool(jnp.all(res.done))
     recall = np.asarray(lookup_recall(swarm, CFG, res, targets))
     assert recall.mean() > 0.9, recall.mean()
+
+
+def test_sharded_lookup_plain_tables():
+    """Swarms too big for augmented tables (aug_tables=False) must
+    still shard: member limbs come from an owner-side id gather."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+    from opendht_tpu.parallel import make_mesh
+    from opendht_tpu.parallel.sharded import sharded_lookup
+
+    cfg = SwarmConfig.for_nodes(1024, aug_tables=False)
+    sw = build_swarm(jax.random.PRNGKey(0), cfg)
+    assert sw.tables.shape[-1] == cfg.bucket_k
+    mesh = make_mesh(8)
+    tg = jax.random.bits(jax.random.PRNGKey(1), (64, 5), jnp.uint32)
+    res = sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2), mesh)
+    assert bool(jnp.all(res.done))

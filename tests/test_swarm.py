@@ -52,7 +52,15 @@ def test_searchsorted_matches_python(swarm):
 def test_bucket_members_share_exact_prefix(swarm):
     ids = swarm.ids
     tables = np.asarray(swarm.tables)
-    n, b_total, k = tables.shape
+    n, b_total, width = tables.shape
+    if width == 2 * CFG.bucket_k:     # augmented: [idx K | m0 K]
+        m0 = tables[..., CFG.bucket_k:].astype(np.uint32)
+        tables = tables[..., :CFG.bucket_k]
+        # the fused member-limb half must equal the members' limb 0
+        ids_np = np.asarray(ids)
+        safe = np.clip(tables, 0, n - 1)
+        assert (m0 == ids_np[:, 0][safe].astype(np.uint32)).all()
+    k = tables.shape[-1]
     rng = np.random.default_rng(0)
     for _ in range(40):
         i = int(rng.integers(n))
