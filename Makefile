@@ -4,7 +4,7 @@
 
 NATIVE_SRC := opendht_tpu/native/dhtcore.cpp
 
-.PHONY: all native test bench clean
+.PHONY: all native test bench gate clean
 
 all: native
 
@@ -16,6 +16,16 @@ test:
 
 bench:
 	python bench.py
+
+# Pre-snapshot gate: the full test suite, the driver's multichip dry
+# run, and a small-size bench on whatever accelerator is present —
+# bench.py's EXACT code path (incl. the recall kernel config) at sizes
+# that finish in ~a minute.  A red gate means do not snapshot: rounds
+# 1 and 2 shipped rc=1 benches precisely because nothing ran this
+# before handing the repo to the driver.
+gate: test
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256
 
 clean:
 	rm -f opendht_tpu/native/libdhtcore-*.so

@@ -32,20 +32,27 @@ REFERENCE_LOOKUPS_PER_SEC = 140.0
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--nodes", type=int, default=1_000_000)
+    ap.add_argument("--nodes", type=int, default=None,
+                    help="swarm size (default: 1M; churn mode: 100k)")
     ap.add_argument("--lookups", type=int, default=1_000_000)
     ap.add_argument("--puts", type=int, default=100_000,
                     help="announce/get batch for --mode putget")
     ap.add_argument("--repeat", type=int, default=3)
     ap.add_argument("--recall-sample", type=int, default=512)
-    ap.add_argument("--mode", choices=("lookups", "putget"),
+    ap.add_argument("--mode", choices=("lookups", "putget", "churn"),
                     default="lookups")
+    ap.add_argument("--kill-frac", type=float, default=0.5,
+                    help="fraction of nodes killed in --mode churn")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture an XLA profiler trace of one timed run")
     args = ap.parse_args()
 
+    if args.nodes is None:
+        args.nodes = 100_000 if args.mode == "churn" else 1_000_000
     if args.mode == "putget":
         return putget_main(args)
+    if args.mode == "churn":
+        return churn_main(args)
 
     from opendht_tpu.models.swarm import (
         SwarmConfig, build_swarm, lookup, true_closest,
@@ -173,6 +180,71 @@ def putget_main(args):
         "hit_rate": float(np.asarray(res.hit).mean()),
         "mean_replicas": float(np.asarray(rep.replicas).mean()),
         "median_hops": float(np.median(np.asarray(res.hops))),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+
+
+def churn_main(args):
+    """Persistence under mass node death: announce, kill a fraction,
+    let survivors republish, re-get — the device twin of the host
+    PersistenceTest scenarios (ref python/tools/dht/tests.py:439-827;
+    maintenance op: Dht::dataPersistence, src/dht.cpp:2887-2947).
+
+    Reports the survival rate (hit rate after churn + republish) and
+    the republish cost; the host-path baseline is 7/8 values re-found
+    after killing all hosting nodes (BASELINE.md, persistence delete).
+    """
+    from opendht_tpu.models.storage import (
+        StoreConfig, announce, empty_store, get_values, republish_from,
+    )
+    from opendht_tpu.models.swarm import SwarmConfig, build_swarm, churn
+
+    cfg = SwarmConfig.for_nodes(args.nodes)
+    scfg = StoreConfig(slots=16, listen_slots=4, max_listeners=1 << 10)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    _ = np.asarray(swarm.tables[:1, :1])
+    p = args.puts
+    keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+
+    store = empty_store(cfg.n_nodes, scfg)
+    store, rep = announce(swarm, cfg, store, scfg, keys, vals, seqs, 0,
+                          jax.random.PRNGKey(2))
+    pre_replicas = float(np.asarray(rep.replicas).mean())
+
+    dead = churn(swarm, jax.random.PRNGKey(3), args.kill_frac, cfg)
+    res_dead = get_values(dead, cfg, store, scfg, keys,
+                          jax.random.PRNGKey(4))
+    survival_no_repub = float(np.asarray(res_dead.hit).mean())
+
+    # Survivors republish everything they hold (storage maintenance).
+    t0 = time.perf_counter()
+    store, rrep = republish_from(dead, cfg, store, scfg,
+                                 jnp.arange(cfg.n_nodes, dtype=jnp.int32),
+                                 1, jax.random.PRNGKey(5))
+    _ = int(np.asarray(jnp.sum(rrep.replicas[:8])))
+    repub_s = time.perf_counter() - t0
+
+    res = get_values(dead, cfg, store, scfg, keys, jax.random.PRNGKey(6))
+    survival = float(np.asarray(res.hit).mean())
+    ok_vals = np.asarray(jnp.where(res.hit, res.val == vals, True))
+
+    out = {
+        "metric": "swarm_churn_survival_rate",
+        "value": round(survival, 4),
+        "unit": "fraction",
+        # Host-path persistence scenario re-found 7/8 after killing all
+        # hosting nodes (BASELINE.md).
+        "vs_baseline": round(survival / (7 / 8), 3),
+        "n_nodes": cfg.n_nodes,
+        "n_puts": p,
+        "kill_frac": args.kill_frac,
+        "mean_replicas_before": round(pre_replicas, 2),
+        "survival_before_republish": round(survival_no_repub, 4),
+        "republish_wall_s": round(repub_s, 3),
+        "values_intact": bool(ok_vals.all()),
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(out))

@@ -1,0 +1,182 @@
+"""Subprocess DHT node driven by a msgpack-over-stdio control protocol.
+
+A miniature of the reference harness's ``DhtNetworkSubProcess``
+(ref: python/tools/dht/network.py:42-280,447-595): the parent spawns
+``python -m opendht_tpu.harness.proc_node``, writes msgpack request
+maps to its stdin and reads msgpack reply maps from its stdout, while
+the node itself talks real UDP on localhost.  This is what puts an OS
+process boundary (separate interpreter, separate GIL, real sockets)
+under the runtime tests — the reference gets the same from netns
+subprocesses.
+
+Requests (maps with ``op``; each gets one reply map with ``ok``):
+
+=============  ============================  ==========================
+op             request fields                reply fields
+=============  ============================  ==========================
+run            port (0 = ephemeral)          port (bound), id (hex)
+bootstrap      host, port                    —
+put            key (20 B), value (bytes)     stored (bool)
+get            key (20 B)                    values (list of bytes)
+listen         key (20 B)                    token (int)
+poll_listen    token (int)                   values (list of bytes)
+stats          —                             good, dubious
+shutdown       —                             — (process exits after)
+=============  ============================  ==========================
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List
+
+import msgpack
+
+from ..core.value import Value
+from ..runtime.dhtrunner import DhtRunner
+from ..utils.infohash import InfoHash
+
+
+def serve(stdin=None, stdout=None) -> None:  # pragma: no cover (subproc)
+    import os
+
+    stdin = stdin or sys.stdin.buffer
+    stdout = stdout or sys.stdout.buffer
+    # Feed the unpacker from incremental reads: wrapping the pipe in
+    # Unpacker(stream) would block in a full buffered read() until EOF.
+    unpacker = msgpack.Unpacker(raw=False)
+    fd = stdin.fileno()
+    runner = DhtRunner()
+    listens: Dict[int, List[bytes]] = {}
+    next_token = [1]
+
+    rid_box = [None]
+
+    def reply(**kw):
+        # Echo the request id so a parent that timed out on one request
+        # can discard its late reply instead of mis-pairing the stream.
+        kw["rid"] = rid_box[0]
+        stdout.write(msgpack.packb(kw, use_bin_type=True))
+        stdout.flush()
+
+    def requests():
+        while True:
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                return
+            unpacker.feed(chunk)
+            yield from unpacker
+
+    for req in requests():
+        op = req.get("op")
+        rid_box[0] = req.get("rid")
+        try:
+            if op == "run":
+                runner.run(port=int(req.get("port", 0)),
+                           bind4="127.0.0.1")
+                reply(ok=True, port=runner.get_bound_port(),
+                      id=str(runner.get_id()))
+            elif op == "bootstrap":
+                runner.bootstrap(req["host"], int(req["port"]))
+                reply(ok=True)
+            elif op == "put":
+                h = InfoHash(req["key"])
+                fut = runner.put_future(h, Value(req["value"]))
+                reply(ok=True, stored=bool(fut.result(timeout=20)))
+            elif op == "get":
+                h = InfoHash(req["key"])
+                vals = runner.get_future(h).result(timeout=20)
+                reply(ok=True, values=[v.data for v in vals])
+            elif op == "listen":
+                h = InfoHash(req["key"])
+                token = next_token[0]
+                next_token[0] += 1
+                box: List[bytes] = []
+                listens[token] = box
+
+                def on_values(vs, box=box):
+                    box.extend(v.data for v in vs)
+                    return True
+                runner.listen(h, on_values)
+                reply(ok=True, token=token)
+            elif op == "poll_listen":
+                box = listens.get(int(req["token"]), [])
+                vals, box[:] = list(box), []
+                reply(ok=True, values=vals)
+            elif op == "stats":
+                st = runner.get_nodes_stats()
+                reply(ok=True, good=int(st[0]), dubious=int(st[1]))
+            elif op == "shutdown":
+                runner.shutdown()
+                runner.join()
+                reply(ok=True)
+                return
+            else:
+                reply(ok=False, error=f"unknown op {op!r}")
+        except Exception as e:  # noqa: BLE001 — report to the parent
+            reply(ok=False, error=f"{type(e).__name__}: {e}")
+
+
+class ProcNode:
+    """Parent-side handle: spawn, drive, and stop a subprocess node."""
+
+    def __init__(self):
+        import subprocess
+
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "opendht_tpu.harness.proc_node"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        self._unpacker = msgpack.Unpacker(raw=False)
+        self._next_rid = 0
+
+    def request(self, timeout: float = 30.0, **req) -> dict:
+        """Send one request, return its reply.
+
+        Requests carry a monotonically increasing ``rid`` echoed in the
+        reply; a reply arriving late for an earlier (timed-out) request
+        is discarded rather than mis-paired with the current one.
+        """
+        self._next_rid += 1
+        rid = self._next_rid
+        req["rid"] = rid
+        self.proc.stdin.write(msgpack.packb(req, use_bin_type=True))
+        self.proc.stdin.flush()
+        end = time.monotonic() + timeout
+        import os
+        import select
+        fd = self.proc.stdout.fileno()
+        while time.monotonic() < end:
+            r, _, _ = select.select([fd], [], [], 0.1)
+            if r:
+                chunk = os.read(fd, 65536)
+                if not chunk:
+                    break
+                self._unpacker.feed(chunk)
+                for msg in self._unpacker:
+                    if msg.get("rid") == rid:
+                        return msg
+                    # stale reply to a timed-out request: drop it
+        raise TimeoutError(f"no reply to {req.get('op')!r}")
+
+    def close(self) -> None:
+        try:
+            if self.proc.poll() is None:
+                self.request(op="shutdown", timeout=10)
+        except Exception:
+            pass
+        finally:
+            try:
+                self.proc.stdin.close()
+            except Exception:
+                pass
+            try:
+                self.proc.wait(timeout=10)
+            except Exception:
+                self.proc.kill()
+                self.proc.wait(timeout=5)
+
+
+if __name__ == "__main__":
+    serve()

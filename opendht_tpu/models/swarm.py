@@ -79,10 +79,11 @@ class SwarmConfig(NamedTuple):
     @classmethod
     def for_nodes(cls, n_nodes: int, **kw) -> "SwarmConfig":
         # Enough buckets that the deepest one holds ~2·K nodes.  Capped
-        # at 32: the hot path derives bucket indices from first-limb
-        # prefix lengths (common_bits32), exact up to that depth — and
-        # 2^35 nodes would be needed to want more.
-        b = min(32, max(4, int(math.ceil(math.log2(max(16, n_nodes)))) - 3))
+        # at 26: bucket indices derive from first-limb prefix lengths
+        # (exact to depth 32), and build_swarm's prefix histograms use
+        # up to 2^depth bins — 26 covers ~2^29 nodes, far past what a
+        # chip holds.
+        b = min(26, max(4, int(math.ceil(math.log2(max(16, n_nodes)))) - 3))
         kw.setdefault("aug_tables", n_nodes <= 2_000_000)
         return cls(n_nodes=n_nodes, n_buckets=b, **kw)
 
@@ -201,23 +202,36 @@ def build_swarm(key: jax.Array, cfg: SwarmConfig) -> Swarm:
     sorted_limbs = jax.lax.sort(limbs, num_keys=N_LIMBS)
     ids = jnp.stack(sorted_limbs, axis=-1)
 
-    u = jax.random.uniform(k_samp, (n, b_total, k))
-
-    def one_bucket(b):
-        lo, hi = bucket_range(ids, ids, b,
-                              inclusive=(b == b_total - 1))  # [N], [N]
+    # Bucket ranges via prefix histograms, not binary search: in the
+    # sorted id matrix every bucket's key-space is a dyadic interval
+    # determined by the first d ≤ 32 bits (d = bucket depth + 1), so
+    # its [lo, hi) is a pair of adjacent prefix-histogram cumsums —
+    # O(N) per bucket with one small gather, where per-node binary
+    # search was O(N log N) random gathers (and its unrolled HLO
+    # crashed the compiler at 10M nodes).
+    assert b_total <= 26, "prefix histogram capped at 2^26 bins"
+    ids0 = ids[:, 0]
+    tables = jnp.full((n, b_total, k), -1, jnp.int32)
+    for b in range(b_total):
+        inclusive = b == b_total - 1
+        d = b if inclusive else b + 1   # prefix depth of the interval
+        pref = (ids0 >> jnp.uint32(32 - d)).astype(jnp.int32) \
+            if d else jnp.zeros((n,), jnp.int32)
+        counts = jnp.zeros((1 << d,), jnp.int32).at[pref].add(1)
+        bounds = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)])
+        p = pref if inclusive else pref ^ 1   # own vs sibling interval
+        lo, hi = bounds[p], bounds[p + 1]
         size = (hi - lo).astype(jnp.float32)
         # Stratified samples across the range: bucket membership is
         # uniform-random in the reference's steady state too.
-        strat = (jnp.arange(k, dtype=jnp.float32)[None, :]
-                 + u[:, b, :]) / k
+        u = jax.random.uniform(jax.random.fold_in(k_samp, b), (n, k))
+        strat = (jnp.arange(k, dtype=jnp.float32)[None, :] + u) / k
         samp = lo[:, None] + jnp.floor(
             strat * size[:, None]).astype(jnp.int32)
         samp = jnp.clip(samp, lo[:, None], hi[:, None] - 1)
-        return jnp.where((hi > lo)[:, None], samp, -1)  # [N,K]
-
-    tables = jax.lax.map(one_bucket, jnp.arange(b_total))  # [B,N,K]
-    tables = jnp.transpose(tables, (1, 0, 2))
+        samp = jnp.where((hi > lo)[:, None], samp, -1)   # [N,K]
+        tables = tables.at[:, b, :].set(samp)
     if cfg.aug_tables:
         m0 = jax.lax.bitcast_convert_type(
             ids[:, 0][jnp.clip(tables, 0, n - 1)], jnp.int32)

@@ -1,0 +1,270 @@
+"""Mesh-sharded value storage: announce/get over a node-sharded store.
+
+The single-chip storage engine (:mod:`opendht_tpu.models.storage`)
+keeps every node's value slots in ``[N, S]`` tensors; this module runs
+the same semantics with those tensors sharded over the 1-D ``"swarm"``
+mesh axis, the storage half of the reference's inherently-multi-node
+design (``Dht::onAnnounce`` / ``onGetValues``,
+/root/reference/src/dht.cpp:3333-3399, 3202-3225).
+
+Both ops follow the same two-phase shape as the sharded lookup:
+
+1. the routed lock-step lookup finds each key's ``quorum`` closest
+   nodes (:func:`opendht_tpu.parallel.sharded._sharded_body`);
+2. storage requests — ``(owner-local row, key, value, seq)`` for
+   announce, ``(owner-local row, key)`` probes for get — ship to the
+   owning shard in the same fixed-capacity ``all_to_all`` buckets as
+   routing queries, are applied/answered against the local store
+   shard, and the per-request outcomes (accept bit / hit-value-seq)
+   ship back to the origin shard for aggregation.
+
+Requests past a shard's capacity are dropped for the round, costing a
+replica (announce) or a probe (get) — the lock-step analogue of the
+reference dropping packets under load and catching up via maintenance
+(``Dht::dataPersistence``, /root/reference/src/dht.cpp:2887-2947).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.storage import (
+    AnnounceReport,
+    GetResult,
+    StoreConfig,
+    SwarmStore,
+    _store_insert,
+    empty_store,
+)
+from ..models.swarm import Swarm, SwarmConfig
+from ..ops.xor_metric import N_LIMBS
+from .mesh import AXIS
+from .sharded import _sharded_body
+
+
+def _u2i(x: jax.Array) -> jax.Array:
+    return jax.lax.bitcast_convert_type(x.astype(jnp.uint32), jnp.int32)
+
+
+def _i2u(x: jax.Array) -> jax.Array:
+    return jax.lax.bitcast_convert_type(x, jnp.uint32)
+
+
+def _cap_for(q: int, n_shards: int, capacity_factor: float) -> int:
+    if math.isfinite(capacity_factor):
+        return min(q, max(1, int(math.ceil(q / n_shards
+                                           * capacity_factor))))
+    return q
+
+
+def _route_out(payload: jax.Array, owner: jax.Array, ok: jax.Array,
+               n_shards: int, cap: int):
+    """Ship ``payload [Q,W]`` rows to their owner shards in capacity-
+    ``cap`` buckets (same scheme as routing queries — see
+    ``_route_respond``).  Returns ``(rbuf [D,cap,W], pos, sent)``;
+    dropped rows have ``sent`` False."""
+    onehot = (owner[:, None] == jnp.arange(n_shards)[None, :]) \
+        & ok[:, None]
+    pos = jnp.take_along_axis(
+        jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1,
+        owner[:, None], axis=1)[:, 0]
+    sent = ok & (pos < cap)
+    qbuf = jnp.full((n_shards, cap + 1, payload.shape[1]), -1, jnp.int32)
+    qbuf = qbuf.at[jnp.where(sent, owner, n_shards - 1),
+                   jnp.where(sent, pos, cap)].set(payload)[:, :cap]
+    rbuf = jax.lax.all_to_all(qbuf, AXIS, split_axis=0, concat_axis=0,
+                              tiled=True)
+    return rbuf, pos, sent
+
+
+def _route_back(resp: jax.Array, owner: jax.Array, pos: jax.Array,
+                sent: jax.Array, cap: int) -> jax.Array:
+    """Return per-request responses ``resp [D,cap,W]`` to their origin
+    rows; unsent rows read -1."""
+    back = jax.lax.all_to_all(resp, AXIS, split_axis=0, concat_axis=0,
+                              tiled=True)
+    mine = back[owner, jnp.clip(pos, 0, cap - 1)]
+    return jnp.where(sent[:, None], mine, -1)
+
+
+def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
+                   capacity_factor: float, ids, tables_local,
+                   alive, store_local: SwarmStore, keys, vals, seqs,
+                   key, now):
+    """Per-shard announce: routed lookup, then routed store inserts."""
+    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
+                                      ids, tables_local, alive, keys,
+                                      key)
+    ll, quorum = found.shape
+    shard_n = cfg.n_nodes // n_shards
+    q = ll * quorum
+
+    flat = found.reshape(-1)
+    safe = jnp.clip(flat, 0, cfg.n_nodes - 1)
+    ok = (flat >= 0) & alive[safe]
+    owner = jnp.clip(safe // shard_n, 0, n_shards - 1).astype(jnp.int32)
+    local_row = jnp.where(ok, safe - owner * shard_n, -1)
+
+    rep = lambda a: jnp.repeat(a, quorum, axis=0)
+    payload = jnp.concatenate(
+        [local_row[:, None], _u2i(rep(keys)),
+         _u2i(rep(vals))[:, None], _u2i(rep(seqs))[:, None]], axis=1)
+
+    cap = _cap_for(q, n_shards, capacity_factor)
+    rbuf, pos, sent = _route_out(payload, owner, ok, n_shards, cap)
+
+    r_node = rbuf[..., 0].reshape(-1)
+    r_key = _i2u(rbuf[..., 1:1 + N_LIMBS]).reshape(-1, N_LIMBS)
+    r_val = _i2u(rbuf[..., 1 + N_LIMBS]).reshape(-1)
+    r_seq = _i2u(rbuf[..., 2 + N_LIMBS]).reshape(-1)
+    m = r_node.shape[0]
+    # req_put = flat request index → _store_insert's replica vector
+    # becomes a per-request accept bit we can route back.
+    store_local, acc = _store_insert(
+        store_local, scfg, r_node, r_key, r_val, r_seq,
+        jnp.arange(m, dtype=jnp.int32), now)
+
+    back = _route_back(acc.reshape(n_shards, cap, 1), owner, pos, sent,
+                       cap)
+    acc_mine = jnp.clip(back[:, 0], 0, 1).reshape(ll, quorum)
+    replicas = jnp.sum(acc_mine, axis=1, dtype=jnp.int32)
+
+    # Listener-notification bits are a global table; merge the shards'
+    # local contributions.
+    notified = jax.lax.pmax(
+        store_local.notified.astype(jnp.int32), AXIS).astype(bool)
+    store_local = store_local._replace(notified=notified)
+    return store_local, replicas, hops, done
+
+
+def _get_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
+              capacity_factor: float, ids, tables_local, alive,
+              store_local: SwarmStore, keys, key):
+    """Per-shard get: routed lookup, then routed store probes."""
+    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
+                                      ids, tables_local, alive, keys,
+                                      key)
+    ll, quorum = found.shape
+    shard_n = cfg.n_nodes // n_shards
+    q = ll * quorum
+
+    flat = found.reshape(-1)
+    safe = jnp.clip(flat, 0, cfg.n_nodes - 1)
+    ok = (flat >= 0) & alive[safe]
+    owner = jnp.clip(safe // shard_n, 0, n_shards - 1).astype(jnp.int32)
+    local_row = jnp.where(ok, safe - owner * shard_n, -1)
+    payload = jnp.concatenate(
+        [local_row[:, None], _u2i(jnp.repeat(keys, quorum, axis=0))],
+        axis=1)
+
+    cap = _cap_for(q, n_shards, capacity_factor)
+    rbuf, pos, sent = _route_out(payload, owner, ok, n_shards, cap)
+
+    r_node = rbuf[..., 0].reshape(-1)
+    r_key = _i2u(rbuf[..., 1:]).reshape(-1, N_LIMBS)
+    shard_rows = store_local.keys.shape[0]
+    n_safe = jnp.clip(r_node, 0, shard_rows - 1)
+    valid = r_node >= 0
+    sk = store_local.keys[n_safe]                        # [M,S,5]
+    hit = store_local.used[n_safe] & valid[:, None] \
+        & jnp.all(sk == r_key[:, None, :], axis=-1)      # [M,S]
+    seq = jnp.where(hit, store_local.seqs[n_safe], 0)
+    best = jnp.max(seq, axis=1)
+    val = jnp.max(jnp.where(hit & (seq == best[:, None]),
+                            store_local.vals[n_safe], 0), axis=1)
+    anyhit = jnp.any(hit, axis=1)
+
+    resp = jnp.stack([anyhit.astype(jnp.int32), _u2i(val), _u2i(best)],
+                     axis=-1).reshape(n_shards, cap, 3)
+    back = _route_back(resp, owner, pos, sent, cap)      # [Q,3]
+    h = (back[:, 0] > 0).reshape(ll, quorum)
+    v = _i2u(jnp.where(sent, back[:, 1], 0)).reshape(ll, quorum)
+    s = _i2u(jnp.where(sent, back[:, 2], 0)).reshape(ll, quorum)
+
+    s = jnp.where(h, s, 0)
+    best_seq = jnp.max(s, axis=1)
+    best_val = jnp.max(jnp.where(h & (s == best_seq[:, None]), v, 0),
+                       axis=1)
+    return jnp.any(h, axis=1), best_val, best_seq, hops, done
+
+
+def _store_specs(mesh: Mesh) -> SwarmStore:
+    """Per-leaf partition specs: node-axis leaves sharded, the global
+    ``notified`` table replicated."""
+    shd = P(AXIS)
+    return SwarmStore(
+        keys=P(AXIS, None, None), vals=P(AXIS, None), seqs=P(AXIS, None),
+        created=P(AXIS, None), used=P(AXIS, None), cursor=shd,
+        lkeys=P(AXIS, None, None), lids=P(AXIS, None), lcursor=shd,
+        notified=P())
+
+
+def shard_store(store: SwarmStore, mesh: Mesh) -> SwarmStore:
+    """Lay an existing store out over the mesh (node axis)."""
+    specs = _store_specs(mesh)
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), store,
+        specs)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "scfg", "mesh", "capacity_factor"))
+def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                     scfg: StoreConfig, keys: jax.Array,
+                     vals: jax.Array, seqs: jax.Array, now,
+                     key: jax.Array, mesh: Mesh,
+                     capacity_factor: float = 4.0
+                     ) -> Tuple[SwarmStore, AnnounceReport]:
+    """Batched put over the sharded swarm + store.
+
+    ``keys [P,5]`` / ``vals [P]`` / ``seqs [P]`` shard on the put axis;
+    store shards on the node axis; P and N must divide the mesh size.
+    ``now`` is traced (a changing sim-time must not recompile).
+    """
+    n_shards = mesh.shape[AXIS]
+    specs = _store_specs(mesh)
+    fn = jax.shard_map(
+        partial(_announce_body, cfg, scfg, n_shards, capacity_factor),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS, None, None), P(), specs, P(AXIS, None),
+                  P(AXIS), P(AXIS), P(), P()),
+        out_specs=(specs, P(AXIS), P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    store, replicas, hops, done = fn(swarm.ids, swarm.tables,
+                                     swarm.alive, store, keys, vals,
+                                     seqs, key, jnp.uint32(now))
+    return store, AnnounceReport(replicas=replicas, hops=hops, done=done)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "scfg", "mesh", "capacity_factor"))
+def sharded_get(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                scfg: StoreConfig, keys: jax.Array, key: jax.Array,
+                mesh: Mesh, capacity_factor: float = 4.0) -> GetResult:
+    """Batched get over the sharded swarm + store (freshest-seq wins)."""
+    n_shards = mesh.shape[AXIS]
+    specs = _store_specs(mesh)
+    fn = jax.shard_map(
+        partial(_get_body, cfg, scfg, n_shards, capacity_factor),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS, None, None), P(), specs, P(AXIS, None),
+                  P()),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    hit, val, seq, hops, done = fn(swarm.ids, swarm.tables, swarm.alive,
+                                   store, keys, key)
+    return GetResult(hit=hit, val=val, seq=seq, hops=hops, done=done)
+
+
+def sharded_empty_store(n_nodes: int, scfg: StoreConfig,
+                        mesh: Mesh) -> SwarmStore:
+    """An empty store laid out over the mesh."""
+    return shard_store(empty_store(n_nodes, scfg), mesh)

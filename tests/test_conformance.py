@@ -1,0 +1,112 @@
+"""Host ↔ device conformance: one spec, two engines.
+
+SURVEY §7 promises the event-driven host core (core/dht.py over the
+virtual transport) and the lock-step device swarm (models/swarm) are
+two implementations of the same Kademlia spec (α=4, k=8, 14-node
+search sets).  This test runs random-key lookups through both at the
+same swarm size and asserts the observable behavior agrees:
+
+* recall of the true 8 XOR-closest nodes among each lookup's answered
+  set is high on both engines and within tolerance of each other;
+* lookup effort agrees: the host's solicitations-per-lookup / α
+  (= rounds, ref searchStep's α-window src/dht.cpp:1438-1449) is in
+  the same small band as the device engine's lock-step hop count.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dht_harness import SimCluster
+from opendht_tpu.models.swarm import SwarmConfig, build_swarm, lookup
+from opendht_tpu.utils.infohash import InfoHash
+
+N_NODES = 48
+N_LOOKUPS = 24
+
+
+def brute_closest(all_ids, target_bytes, k=8):
+    t = int.from_bytes(target_bytes, "big")
+    d = sorted((int.from_bytes(bytes(h), "big") ^ t, i)
+               for i, h in enumerate(all_ids))
+    return [i for _, i in d[:k]]
+
+
+def recall_of(found_ids, all_ids, target_bytes, k=8):
+    truth = {bytes(all_ids[i]) for i in brute_closest(all_ids,
+                                                      target_bytes, k)}
+    return len(truth & {bytes(f) for f in found_ids}) / len(truth)
+
+
+@pytest.fixture(scope="module")
+def host_cluster():
+    c = SimCluster(N_NODES, seed=7)
+    c.interconnect()
+    c.run(5.0)
+    yield c
+
+
+def host_lookup_stats(c):
+    """Run N_LOOKUPS random gets through the host engine; collect
+    recall of answered node sets and solicitations-per-lookup."""
+    rng = np.random.default_rng(3)
+    all_ids = [d.myid for d in c.nodes]
+    recalls, rounds = [], []
+    for i in range(N_LOOKUPS):
+        target = InfoHash(rng.bytes(20))
+        src = c.nodes[int(rng.integers(len(c.nodes)))]
+        before = sum(n.engine.stats_out.get("get", 0)
+                     + n.engine.stats_out.get("find", 0)
+                     for n in c.nodes)
+        done = []
+        src.get(target, lambda vs: True,
+                lambda ok, nodes: done.append([n.id for n in nodes]))
+        c.run_until(lambda: done, timeout=60.0)
+        after = sum(n.engine.stats_out.get("get", 0)
+                    + n.engine.stats_out.get("find", 0)
+                    for n in c.nodes)
+        assert done, "host lookup did not complete"
+        recalls.append(recall_of(done[0], all_ids, bytes(target)))
+        # α solicitations per round → rounds ≈ sent / α
+        rounds.append((after - before) / 4.0)
+    return np.array(recalls), np.array(rounds)
+
+
+def device_lookup_stats():
+    cfg = SwarmConfig.for_nodes(N_NODES)
+    sw = build_swarm(jax.random.PRNGKey(7), cfg)
+    targets = jax.random.bits(jax.random.PRNGKey(3), (N_LOOKUPS, 5),
+                              jnp.uint32)
+    res = lookup(sw, cfg, targets, jax.random.PRNGKey(4))
+    ids_np = np.asarray(sw.ids)
+    found = np.asarray(res.found)
+    t_np = np.asarray(targets)
+    all_ids = [b"".join(int(x).to_bytes(4, "big") for x in row)
+               for row in ids_np]
+    recalls = []
+    for i in range(N_LOOKUPS):
+        tb = b"".join(int(x).to_bytes(4, "big") for x in t_np[i])
+        fids = [all_ids[j] for j in found[i] if j >= 0]
+        recalls.append(recall_of(fids, all_ids, tb))
+    return np.array(recalls), np.asarray(res.hops)
+
+
+def test_host_device_conformance(host_cluster):
+    h_recall, h_rounds = host_lookup_stats(host_cluster)
+    d_recall, d_hops = device_lookup_stats()
+
+    # Both engines must find (nearly) all of the true 8-closest.
+    assert h_recall.mean() > 0.85, h_recall.mean()
+    assert d_recall.mean() > 0.85, d_recall.mean()
+    assert abs(h_recall.mean() - d_recall.mean()) < 0.15, (
+        h_recall.mean(), d_recall.mean())
+
+    # Effort: rounds-to-converge in the same small band.  At 48 nodes
+    # both engines should converge in a handful of rounds; allow a
+    # generous factor for the engines' different round semantics.
+    h_med, d_med = float(np.median(h_rounds)), float(np.median(d_hops))
+    assert d_med <= 12 and h_med <= 12, (h_med, d_med)
+    assert h_med <= 4 * max(d_med, 1) and d_med <= 4 * max(h_med, 1), (
+        h_med, d_med)

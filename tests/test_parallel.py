@@ -98,3 +98,89 @@ def test_sharded_lookup_plain_tables():
     tg = jax.random.bits(jax.random.PRNGKey(1), (64, 5), jnp.uint32)
     res = sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2), mesh)
     assert bool(jnp.all(res.done))
+
+
+def _mk_sharded_store_env(n_nodes=2048, p=128):
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.models.storage import StoreConfig
+    from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+    from opendht_tpu.parallel import make_mesh
+
+    cfg = SwarmConfig.for_nodes(n_nodes)
+    sw = build_swarm(jax.random.PRNGKey(0), cfg)
+    scfg = StoreConfig(slots=8, listen_slots=2, max_listeners=256)
+    mesh = make_mesh(8)
+    keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+    return cfg, sw, scfg, mesh, keys, vals, seqs
+
+
+def test_sharded_putget_roundtrip():
+    """Announce into the node-sharded store, get back: hit-rate ~1 with
+    uncapped capacity, and returned values must match what was put."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_get,
+    )
+
+    cfg, sw, scfg, mesh, keys, vals, seqs = _mk_sharded_store_env()
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    store, rep = sharded_announce(sw, cfg, store, scfg, keys, vals,
+                                  seqs, 0, jax.random.PRNGKey(2), mesh,
+                                  capacity_factor=float("inf"))
+    assert float(jnp.mean(rep.replicas)) > 3  # most of quorum=8 stored
+    res = sharded_get(sw, cfg, store, scfg, keys, jax.random.PRNGKey(3),
+                      mesh, capacity_factor=float("inf"))
+    assert float(jnp.mean(res.hit)) > 0.95
+    ok = jnp.where(res.hit, res.val == vals, True)
+    assert bool(jnp.all(ok))
+
+
+def test_sharded_putget_capacity_drops_retryable():
+    """Tight capacity drops some storage requests (fewer replicas) but
+    never corrupts: returned values still match, hits still happen."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_get,
+    )
+
+    cfg, sw, scfg, mesh, keys, vals, seqs = _mk_sharded_store_env()
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    store, rep = sharded_announce(sw, cfg, store, scfg, keys, vals,
+                                  seqs, 0, jax.random.PRNGKey(2), mesh,
+                                  capacity_factor=1.5)
+    tight = float(jnp.mean(rep.replicas))
+    assert tight > 0
+    res = sharded_get(sw, cfg, store, scfg, keys, jax.random.PRNGKey(3),
+                      mesh, capacity_factor=float("inf"))
+    ok = jnp.where(res.hit, res.val == vals, True)
+    assert bool(jnp.all(ok))
+    assert float(jnp.mean(res.hit)) > 0.5
+
+
+def test_sharded_announce_seq_edit_policy():
+    """A second announce of the same keys with lower seq must not
+    overwrite (monotone-seq edit policy, securedht.cpp:103-118)."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_get,
+    )
+
+    cfg, sw, scfg, mesh, keys, vals, seqs = _mk_sharded_store_env(p=64)
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals,
+                                seqs + 5, 0, jax.random.PRNGKey(2),
+                                mesh, capacity_factor=float("inf"))
+    # lower-seq overwrite attempt with different values
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals + 777,
+                                seqs, 1, jax.random.PRNGKey(4), mesh,
+                                capacity_factor=float("inf"))
+    res = sharded_get(sw, cfg, store, scfg, keys, jax.random.PRNGKey(3),
+                      mesh, capacity_factor=float("inf"))
+    ok = jnp.where(res.hit, res.val == vals, True)
+    assert bool(jnp.all(ok)), "stale-seq announce overwrote fresh values"
