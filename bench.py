@@ -30,6 +30,15 @@ import numpy as np
 REFERENCE_LOOKUPS_PER_SEC = 140.0
 
 
+class LookupResultConcat:
+    """Concatenated view over per-chunk LookupResults (host-side)."""
+
+    def __init__(self, results):
+        self.found = jnp.concatenate([r.found for r in results])
+        self.hops = jnp.concatenate([r.hops for r in results])
+        self.done = jnp.concatenate([r.done for r in results])
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=None,
@@ -37,12 +46,22 @@ def main():
     ap.add_argument("--lookups", type=int, default=1_000_000)
     ap.add_argument("--puts", type=int, default=100_000,
                     help="announce/get batch for --mode putget")
+    ap.add_argument("--aug", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="augmented tables (auto: on up to 2M nodes)")
+    ap.add_argument("--lookup-batch", type=int, default=0,
+                    help="split lookups into device batches of this "
+                         "size (0 = single batch); lets big-N swarms "
+                         "use augmented tables within HBM")
     ap.add_argument("--repeat", type=int, default=3)
     ap.add_argument("--recall-sample", type=int, default=512)
     ap.add_argument("--mode", choices=("lookups", "putget", "churn"),
                     default="lookups")
     ap.add_argument("--kill-frac", type=float, default=0.5,
                     help="fraction of nodes killed in --mode churn")
+    ap.add_argument("--zipf", type=float, default=0.0,
+                    help="churn mode: draw gets Zipf(s)-skewed over "
+                         "the put keyset (0 = uniform, one get/key)")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture an XLA profiler trace of one timed run")
     args = ap.parse_args()
@@ -58,13 +77,16 @@ def main():
         SwarmConfig, build_swarm, lookup, true_closest,
     )
 
-    cfg = SwarmConfig.for_nodes(args.nodes)
+    kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    cfg = SwarmConfig.for_nodes(args.nodes, **kw)
     key = jax.random.PRNGKey(0)
     swarm = build_swarm(key, cfg)
     _ = np.asarray(swarm.tables[:1, :1])   # force build
 
     targets = jax.random.bits(jax.random.PRNGKey(1), (args.lookups, 5),
                               jnp.uint32)
+    lb = args.lookup_batch or args.lookups
+    chunks = [targets[lo:lo + lb] for lo in range(0, args.lookups, lb)]
 
     def sync(res):
         # A value fetch is the only reliable completion barrier in the
@@ -74,23 +96,28 @@ def main():
         # multi-MB array transfer inside the timed region.
         return int(np.asarray(jnp.sum(res.found[:, 0])))
 
-    res = lookup(swarm, cfg, targets, jax.random.PRNGKey(2))  # warmup
-    sync(res)
+    def run_all(seed):
+        rs = [lookup(swarm, cfg, c, jax.random.PRNGKey(seed + i))
+              for i, c in enumerate(chunks)]
+        for r in rs:
+            sync(r)
+        return rs
+
+    ress = run_all(2)  # warmup/compile
 
     if args.profile:
         with jax.profiler.trace(args.profile):
-            res = lookup(swarm, cfg, targets, jax.random.PRNGKey(99))
-            sync(res)
+            run_all(99)
 
     times = []
     for r in range(args.repeat):
         t0 = time.perf_counter()
-        res = lookup(swarm, cfg, targets, jax.random.PRNGKey(3 + r))
-        sync(res)
+        ress = run_all(300 + 100 * r)
         times.append(time.perf_counter() - t0)
     dt = min(times)
     lps = args.lookups / dt
 
+    res = LookupResultConcat(ress)
     hops = np.asarray(res.hops)
 
     # Recall on a subsample (exact k-closest over the full matrix is
@@ -115,6 +142,10 @@ def main():
         "value": round(lps, 1),
         "unit": "lookups/s",
         "vs_baseline": round(lps / REFERENCE_LOOKUPS_PER_SEC, 2),
+        "baseline_note": "vs our measured Python reimplementation of "
+                         "the reference architecture (140 lookups/s, "
+                         "BASELINE.md; C++ reference unbuildable here, "
+                         "publishes no numbers)",
         "n_nodes": args.nodes,
         "n_lookups": args.lookups,
         "wall_s": round(dt, 4),
@@ -158,14 +189,19 @@ def putget_main(args):
                          jax.random.PRNGKey(seed + 1))
         return rep, res
 
+    def sync(res):
+        # Scalar fetch = the only honest completion barrier here (see
+        # the lookups mode).
+        return int(np.asarray(jnp.sum(res.val[:8])))
+
     rep, res = roundtrip(2)  # warmup/compile
-    jax.block_until_ready(res.hit)
+    sync(res)
 
     times = []
     for r in range(args.repeat):
         t0 = time.perf_counter()
         rep, res = roundtrip(10 + 2 * r)
-        jax.block_until_ready(res.hit)
+        sync(res)
         times.append(time.perf_counter() - t0)
     dt = min(times)
 
@@ -214,8 +250,19 @@ def churn_main(args):
                           jax.random.PRNGKey(2))
     pre_replicas = float(np.asarray(rep.replicas).mean())
 
+    # Get workload: uniform (each key once) or Zipf-skewed popularity
+    # (the scenario of BASELINE.md "100k-node swarm, Zipf keys, churn").
+    if args.zipf > 0:
+        rnk = np.arange(1, p + 1, dtype=np.float64)
+        prob = rnk ** -args.zipf
+        prob /= prob.sum()
+        g_idx = np.random.default_rng(9).choice(p, size=p, p=prob)
+        get_keys = keys[jnp.asarray(g_idx)]
+    else:
+        get_keys = keys
+
     dead = churn(swarm, jax.random.PRNGKey(3), args.kill_frac, cfg)
-    res_dead = get_values(dead, cfg, store, scfg, keys,
+    res_dead = get_values(dead, cfg, store, scfg, get_keys,
                           jax.random.PRNGKey(4))
     survival_no_repub = float(np.asarray(res_dead.hit).mean())
 
@@ -227,9 +274,11 @@ def churn_main(args):
     _ = int(np.asarray(jnp.sum(rrep.replicas[:8])))
     repub_s = time.perf_counter() - t0
 
-    res = get_values(dead, cfg, store, scfg, keys, jax.random.PRNGKey(6))
+    res = get_values(dead, cfg, store, scfg, get_keys,
+                     jax.random.PRNGKey(6))
     survival = float(np.asarray(res.hit).mean())
-    ok_vals = np.asarray(jnp.where(res.hit, res.val == vals, True))
+    get_vals = vals if args.zipf <= 0 else vals[jnp.asarray(g_idx)]
+    ok_vals = np.asarray(jnp.where(res.hit, res.val == get_vals, True))
 
     out = {
         "metric": "swarm_churn_survival_rate",
@@ -241,6 +290,7 @@ def churn_main(args):
         "n_nodes": cfg.n_nodes,
         "n_puts": p,
         "kill_frac": args.kill_frac,
+        "zipf": args.zipf,
         "mean_replicas_before": round(pre_replicas, 2),
         "survival_before_republish": round(survival_no_repub, 4),
         "republish_wall_s": round(repub_s, 3),

@@ -178,5 +178,64 @@ class ProcNode:
                 self.proc.wait(timeout=5)
 
 
+class ProcCluster:
+    """A cluster of subprocess nodes — the multi-process half of the
+    harness (ref ``DhtNetwork`` managing ``DhtNetworkSubProcess``
+    clusters, python/tools/dht/network.py:283-445).
+
+    Each node is its own OS process with real UDP sockets on
+    localhost, star-bootstrapped to node 0.
+    """
+
+    def __init__(self, n: int):
+        # Build incrementally so a spawn failure partway still closes
+        # the processes already started.
+        self.nodes: List[ProcNode] = []
+        self.ports: List[int] = []
+        try:
+            for _ in range(n):
+                self.nodes.append(ProcNode())
+            for node in self.nodes:
+                r = node.request(op="run", port=0)
+                if not r.get("ok"):
+                    raise RuntimeError(f"run failed: {r}")
+                self.ports.append(r["port"])
+            for i, node in enumerate(self.nodes):
+                peer = self.ports[0] if i else self.ports[-1]
+                r = node.request(op="bootstrap", host="127.0.0.1",
+                                 port=peer)
+                if not r.get("ok"):
+                    raise RuntimeError(f"bootstrap failed: {r}")
+        except Exception:
+            self.close()
+            raise
+
+    def wait_connected(self, min_good: int = 1,
+                       timeout: float = 60.0) -> bool:
+        """Every node sees ≥ min_good good peers."""
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            stats = [n.request(op="stats") for n in self.nodes]
+            if all(s.get("good", 0) >= min_good for s in stats):
+                return True
+            time.sleep(0.2)
+        return False
+
+    def put(self, i: int, key: bytes, value: bytes) -> bool:
+        r = self.nodes[i].request(op="put", key=key, value=value)
+        return bool(r.get("ok") and r.get("stored"))
+
+    def get(self, i: int, key: bytes) -> List[bytes]:
+        r = self.nodes[i].request(op="get", key=key)
+        return list(r.get("values", []))
+
+    def stats(self) -> List[dict]:
+        return [n.request(op="stats") for n in self.nodes]
+
+    def close(self) -> None:
+        for n in self.nodes:
+            n.close()
+
+
 if __name__ == "__main__":
     serve()

@@ -79,14 +79,20 @@ class StoreConfig(NamedTuple):
 
     ``slots`` scales the reference's per-node budget (≤1024 values/hash,
     64 MB total, callbacks.h:72 / dht.h:333-339) down to simulation
-    size; ``ttl`` is in abstract sim-time units (0 disables expiry),
-    standing in for the per-ValueType expiration
-    (/root/reference/include/opendht/value.h:75-106).
+    size; ``ttl`` is the default per-value lifetime in abstract
+    sim-time units (0 disables expiry), standing in for the
+    per-ValueType expiration
+    (/root/reference/include/opendht/value.h:75-106) — announces may
+    override it per value.  ``budget`` is the per-node total stored
+    *size* cap in abstract units (0 = unlimited), the scaled analog of
+    the reference's 64 MB ``max_store_size``; values also carry sizes,
+    so full-node rejection is by bytes, not just slot count.
     """
     slots: int = 16
     listen_slots: int = 4
     ttl: int = 0
     max_listeners: int = 1 << 16
+    budget: int = 0
 
 
 class SwarmStore(NamedTuple):
@@ -101,6 +107,8 @@ class SwarmStore(NamedTuple):
     lids: jax.Array      # [N,LS] int32    — listener registration id, -1
     lcursor: jax.Array   # [N] uint32
     notified: jax.Array  # [max_listeners] bool — listener got a push
+    sizes: jax.Array     # [N,S] uint32   — stored value sizes
+    ttls: jax.Array      # [N,S] uint32   — per-value ttl (0 = cfg.ttl)
 
 
 class AnnounceReport(NamedTuple):
@@ -131,6 +139,8 @@ def empty_store(n_nodes: int, scfg: StoreConfig) -> SwarmStore:
         lids=jnp.full((n, ls), -1, jnp.int32),
         lcursor=jnp.zeros((n,), jnp.uint32),
         notified=jnp.zeros((scfg.max_listeners,), bool),
+        sizes=jnp.zeros((n, s), jnp.uint32),
+        ttls=jnp.zeros((n, s), jnp.uint32),
     )
 
 
@@ -138,52 +148,78 @@ def empty_store(n_nodes: int, scfg: StoreConfig) -> SwarmStore:
 # core vectorized insert (the onAnnounce storage path)
 # ---------------------------------------------------------------------------
 
-def _segment_rank(sorted_node: jax.Array, flag: jax.Array) -> jax.Array:
+def _segment_excl_sum(weights: jax.Array,
+                      first: jax.Array) -> jax.Array:
+    """Per-row exclusive prefix sum within each segment.
+
+    ``first[i]`` = index of the first row of row i's segment (from a
+    ``searchsorted(sorted_node, sorted_node)`` the caller computes
+    once and shares).
+    """
+    c = jnp.cumsum(weights) - weights
+    return c - c[first]
+
+
+def _segment_rank(sorted_node: jax.Array, flag: jax.Array,
+                  first: jax.Array | None = None) -> jax.Array:
     """Rank of each flagged row within its node segment.
 
     ``sorted_node`` ascending; ``flag`` marks rows that consume a slot.
     Returns, per row, the number of flagged rows strictly before it in
     the same segment.
     """
-    before = jnp.cumsum(flag.astype(jnp.int32)) - flag.astype(jnp.int32)
-    first = jnp.searchsorted(sorted_node, sorted_node, side="left")
-    return before - before[first]
+    if first is None:
+        first = jnp.searchsorted(sorted_node, sorted_node, side="left")
+    return _segment_excl_sum(flag.astype(jnp.int32), first)
 
 
 @partial(jax.jit, static_argnames=("scfg",))
 def _store_insert(store: SwarmStore, scfg: StoreConfig,
                   req_node: jax.Array, req_key: jax.Array,
                   req_val: jax.Array, req_seq: jax.Array,
-                  req_put: jax.Array, now: jax.Array
+                  req_put: jax.Array, now: jax.Array,
+                  req_size: jax.Array | None = None,
+                  req_ttl: jax.Array | None = None
                   ) -> Tuple[SwarmStore, jax.Array]:
     """Insert a flat batch of (node, key, val, seq) storage requests.
 
     ``req_node [M]`` (-1 = skip), ``req_key [M,5]``, ``req_val [M]``,
-    ``req_seq [M]``, ``req_put [M]`` (originating put row).  Returns
-    the new store and accepted-replica counts scattered by ``req_put``
-    into a length-M vector (callers slice the first P rows).
+    ``req_seq [M]``, ``req_put [M]`` (originating put row);
+    ``req_size``/``req_ttl`` optional ``[M]`` (default 1 / cfg
+    default).  Returns the new store and accepted-replica counts
+    scattered by ``req_put`` into a length-M vector (callers slice the
+    first P rows).
 
     Semantics per request, mirroring ``Dht::storageStore`` +
     ``secureType`` edit policy:
     * key already stored on the node → overwrite iff ``seq >=`` stored
       seq (refresh/edit), else reject;
     * new key → ring-slot insert (oldest evicted when full), at most
-      ``slots`` new keys per node per batch (excess dropped — the
-      budget-full drop).
+      ``slots`` new keys per node per batch (excess dropped), and —
+      when ``scfg.budget`` is set — only while the node's stored bytes
+      plus the batch's earlier-ranked new bytes stay within budget
+      (conservative: bytes freed by ring eviction are not credited
+      until the next batch), the scaled ``max_store_size`` rejection
+      of ``Dht::storageStore`` (/root/reference/src/dht.cpp:2227-2258).
     """
     s = scfg.slots
     m = req_node.shape[0]
     valid = req_node >= 0
+    if req_size is None:
+        req_size = jnp.ones((m,), jnp.uint32)
+    if req_ttl is None:
+        req_ttl = jnp.zeros((m,), jnp.uint32)
 
     # --- sort requests by (node, key, seq) so per-node work is contiguous
     node_sk = jnp.where(valid, req_node, INT32_MAX)
     sort_ops = (node_sk,) + tuple(req_key[:, i] for i in range(N_LIMBS)) \
-        + (req_seq, req_val, req_put, req_node)
+        + (req_seq, req_val, req_put, req_node, req_size, req_ttl)
     out = jax.lax.sort(sort_ops, dimension=0, num_keys=N_LIMBS + 2,
                        is_stable=True)
     s_node_sk = out[0]
     s_key = jnp.stack(out[1:1 + N_LIMBS], axis=-1)
     s_seq, s_val, s_put, s_node = out[1 + N_LIMBS:5 + N_LIMBS]
+    s_size, s_ttl = out[5 + N_LIMBS], out[6 + N_LIMBS]
     s_valid = s_node >= 0
 
     # --- in-batch dedup: same (node, key) → keep the last (max seq) row
@@ -202,17 +238,43 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
 
     n_nodes = store.keys.shape[0]
 
+    first = jnp.searchsorted(s_node_sk, s_node_sk, side="left")
+
     # --- update path (edit policy: seq must not decrease)
     cur_seq = store.seqs[n_safe, mslot]
     upd = live & has_match & (s_seq >= cur_seq)
+    if scfg.budget:
+        # A refresh may grow the value: enforce the byte cap on the
+        # size delta too (per-request against the pre-batch total —
+        # concurrent same-node updates in one batch are each checked
+        # against that same base, a documented approximation).
+        node_bytes = jnp.sum(
+            jnp.where(store.used, store.sizes, 0), axis=1)  # [N]
+        base = node_bytes[n_safe]
+        old_size = jnp.where(has_match, store.sizes[n_safe, mslot], 0)
+        upd = upd & (base - old_size + s_size
+                     <= jnp.uint32(scfg.budget))
     un, us = jnp.where(upd, s_node, n_nodes), mslot
     vals = _pad1(store.vals).at[un, us].set(s_val)
     seqs = _pad1(store.seqs).at[un, us].set(s_seq)
     created = _pad1(store.created).at[un, us].set(now)
+    sizes = _pad1(store.sizes).at[un, us].set(s_size)
+    ttls = _pad1(store.ttls).at[un, us].set(s_ttl)
 
     # --- new-key path: ring-slot allocation, ≤ slots per node per batch
     new = live & ~has_match
-    rank = _segment_rank(s_node_sk, new)
+    if scfg.budget:
+        # Byte budget: stored bytes on the node + this batch's
+        # earlier-ranked candidate bytes must leave room.
+        # Conservative on purpose: a row rejected for size still
+        # counts against later rows this batch (they retry at the next
+        # announce/maintenance round).  A refinement that re-admits
+        # shadowed rows can overshoot the cap — mutually-blind
+        # re-accepts can sum past budget — and the cap is a hard
+        # invariant here, like the reference's storageStore rejection.
+        cum = _segment_excl_sum(jnp.where(new, s_size, 0), first)
+        new = new & (base + cum + s_size <= jnp.uint32(scfg.budget))
+    rank = _segment_rank(s_node_sk, new, first)
     slot = ((store.cursor[n_safe] + rank.astype(jnp.uint32))
             % jnp.uint32(s)).astype(jnp.int32)
     # A ring slot may coincide with a slot an *update in this same
@@ -228,6 +290,8 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     vals = vals.at[nn, slot].set(s_val)[:-1]
     seqs = seqs.at[nn, slot].set(s_seq)[:-1]
     created = created.at[nn, slot].set(now)[:-1]
+    sizes = sizes.at[nn, slot].set(s_size)[:-1]
+    ttls = ttls.at[nn, slot].set(s_ttl)[:-1]
     used = _pad1(store.used).at[nn, slot].set(True)[:-1]
     n_new = jnp.zeros_like(store.cursor).at[jnp.where(accept_new, s_node, 0)
                                             ].add(accept_new.astype(jnp.uint32))
@@ -246,7 +310,7 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
 
     new_store = store._replace(keys=keys, vals=vals, seqs=seqs,
                                created=created, used=used, cursor=cursor,
-                               notified=notified)
+                               notified=notified, sizes=sizes, ttls=ttls)
     # Per-put replica counts.
     put_safe = jnp.clip(s_put, 0, None)
     replicas = jnp.zeros((m,), jnp.int32).at[put_safe].add(
@@ -270,28 +334,37 @@ def _announce_targets(swarm: Swarm, cfg: SwarmConfig, keys: jax.Array,
 def _announce_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                      scfg: StoreConfig, res_found: jax.Array,
                      keys: jax.Array, vals: jax.Array, seqs: jax.Array,
-                     now: jax.Array) -> Tuple[SwarmStore, jax.Array]:
+                     now: jax.Array, sizes: jax.Array | None = None,
+                     ttls: jax.Array | None = None
+                     ) -> Tuple[SwarmStore, jax.Array]:
     p, q = res_found.shape
     req_node = _mask_dead(swarm, cfg, res_found.reshape(-1))
     req_key = jnp.repeat(keys, q, axis=0)
     req_val = jnp.repeat(vals, q, axis=0)
     req_seq = jnp.repeat(seqs, q, axis=0)
     req_put = jnp.repeat(jnp.arange(p, dtype=jnp.int32), q, axis=0)
+    req_size = None if sizes is None else jnp.repeat(sizes, q, axis=0)
+    req_ttl = None if ttls is None else jnp.repeat(ttls, q, axis=0)
     store, rep_m = _store_insert(store, scfg, req_node, req_key, req_val,
-                                 req_seq, req_put, now)
+                                 req_seq, req_put, now, req_size,
+                                 req_ttl)
     return store, rep_m[:p]
 
 
 def announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
              scfg: StoreConfig, keys: jax.Array, vals: jax.Array,
-             seqs: jax.Array, now, rng: jax.Array
+             seqs: jax.Array, now, rng: jax.Array,
+             sizes: jax.Array | None = None,
+             ttls: jax.Array | None = None
              ) -> Tuple[SwarmStore, AnnounceReport]:
     """Batched put: lookup each key, store at its quorum closest alive
-    nodes.  ``keys [P,5]``, ``vals [P]``, ``seqs [P]``."""
+    nodes.  ``keys [P,5]``, ``vals [P]``, ``seqs [P]``; optional
+    per-value ``sizes`` (budget accounting) and ``ttls`` (per-type
+    expiration), both ``[P]``."""
     res = _announce_targets(swarm, cfg, keys, rng)
     store, replicas = _announce_insert(
         swarm, cfg, store, scfg, res.found, keys, vals, seqs,
-        jnp.uint32(now))
+        jnp.uint32(now), sizes, ttls)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
                                  done=res.done)
 
@@ -388,11 +461,15 @@ def listen_at(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
 
 @partial(jax.jit, static_argnames=("scfg",))
 def expire(store: SwarmStore, scfg: StoreConfig, now) -> SwarmStore:
-    """TTL sweep (``Storage::expire``).  No-op when ``ttl == 0``."""
-    if scfg.ttl == 0:
-        return store
+    """TTL sweep (``Storage::expire``, src/dht.cpp:2361-2381).
+
+    Per-value TTLs (set at announce — the per-ValueType expiration)
+    take precedence; values with ttl 0 fall back to ``scfg.ttl``; when
+    both are 0 the value is permanent.
+    """
     age = jnp.uint32(now) - store.created
-    return store._replace(used=store.used & (age <= jnp.uint32(scfg.ttl)))
+    eff = jnp.where(store.ttls > 0, store.ttls, jnp.uint32(scfg.ttl))
+    return store._replace(used=store.used & ((eff == 0) | (age <= eff)))
 
 
 def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
@@ -414,10 +491,13 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     keys = store.keys[n_safe].reshape(-1, N_LIMBS)
     vals = store.vals[n_safe].reshape(-1)
     seqs = store.seqs[n_safe].reshape(-1)
+    sizes = store.sizes[n_safe].reshape(-1)
+    ttls = store.ttls[n_safe].reshape(-1)
     okf = ok.reshape(-1)
     res = lookup(swarm, cfg, keys, rng)
     found = jnp.where(okf[:, None], res.found, -1)
     store, replicas = _announce_insert(swarm, cfg, store, scfg, found,
-                                       keys, vals, seqs, jnp.uint32(now))
+                                       keys, vals, seqs,
+                                       jnp.uint32(now), sizes, ttls)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
                                  done=res.done)

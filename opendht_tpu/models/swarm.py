@@ -211,7 +211,8 @@ def build_swarm(key: jax.Array, cfg: SwarmConfig) -> Swarm:
     # crashed the compiler at 10M nodes).
     assert b_total <= 26, "prefix histogram capped at 2^26 bins"
     ids0 = ids[:, 0]
-    tables = jnp.full((n, b_total, k), -1, jnp.int32)
+    width = 2 * k if cfg.aug_tables else k
+    tables = jnp.full((n, b_total, width), -1, jnp.int32)
     for b in range(b_total):
         inclusive = b == b_total - 1
         d = b if inclusive else b + 1   # prefix depth of the interval
@@ -231,11 +232,14 @@ def build_swarm(key: jax.Array, cfg: SwarmConfig) -> Swarm:
             strat * size[:, None]).astype(jnp.int32)
         samp = jnp.clip(samp, lo[:, None], hi[:, None] - 1)
         samp = jnp.where((hi > lo)[:, None], samp, -1)   # [N,K]
+        if cfg.aug_tables:
+            # Fused row [idx K | member-limb K], filled per bucket so
+            # the peak stays at tables + one [N,2K] slice (a whole-
+            # table concat would transiently triple the footprint).
+            m0 = jax.lax.bitcast_convert_type(
+                ids0[jnp.clip(samp, 0, n - 1)], jnp.int32)
+            samp = jnp.concatenate([samp, m0], axis=-1)  # [N,2K]
         tables = tables.at[:, b, :].set(samp)
-    if cfg.aug_tables:
-        m0 = jax.lax.bitcast_convert_type(
-            ids[:, 0][jnp.clip(tables, 0, n - 1)], jnp.int32)
-        tables = jnp.concatenate([tables, m0], axis=-1)    # [N,B,2K]
     return Swarm(ids=ids, tables=tables, alive=jnp.ones((n,), bool))
 
 
@@ -284,12 +288,17 @@ def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     l = targets.shape[0]
     safe = jnp.clip(nid, 0, n - 1)
     c = prefix_len32(nid_d0)                                    # [L,A]
-    c0 = jnp.clip(c, 0, b_total - 1)
-    c1 = jnp.clip(c + 1, 0, b_total - 1)
-    rows0 = swarm.tables[safe, c0]                          # [L,A,K|2K]
-    rows1 = swarm.tables[safe, c1]
+    # One fetch per solicited node: buckets c and c+1 are adjacent
+    # rows, so gather a [2, width] slice starting at min(c, B-2) —
+    # random-gather cost is per fetch, not per byte.  (At the deepest
+    # bucket this returns rows B-2 and B-1 where the per-row form
+    # returned B-1 twice; a superset of candidates, same semantics.)
+    c0 = jnp.clip(c, 0, b_total - 2)
+    width = swarm.tables.shape[-1]
+    rows = _gather_rows2(swarm.tables, safe, c0)        # [L,A,2,width]
+    rows0, rows1 = rows[..., 0, :], rows[..., 1, :]
     ok = (nid >= 0) & swarm.alive[safe]
-    if swarm.tables.shape[-1] == 2 * k:                     # augmented
+    if width == 2 * k:                                      # augmented
         resp = jnp.concatenate([rows0[..., :k], rows1[..., :k]],
                                axis=-1)
         resp = jnp.where(ok[..., None], resp, -1).reshape(l, -1)
@@ -303,6 +312,25 @@ def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         resp = jnp.where(ok[..., None], resp, -1).reshape(l, -1)
         d0 = _resp_dist(swarm.ids, cfg, targets, resp)
     return resp, d0, ok
+
+
+def _gather_rows2(tables: jax.Array, node: jax.Array,
+                  bucket: jax.Array) -> jax.Array:
+    """Gather ``tables[node, bucket:bucket+2, :]`` → ``[..., 2, W]``.
+
+    A single gather op with slice size 2 on the bucket axis — half the
+    fetches of two per-row gathers.  ``bucket`` must be ≤ B-2.
+    """
+    b_total, w = tables.shape[1], tables.shape[2]
+    idx = jnp.stack([node, bucket], axis=-1)          # [..., 2]
+    return jax.lax.gather(
+        tables, idx,
+        jax.lax.GatherDimensionNumbers(
+            offset_dims=(node.ndim, node.ndim + 1),
+            collapsed_slice_dims=(0,),
+            start_index_map=(0, 1)),
+        slice_sizes=(1, 2, w),
+        mode=jax.lax.GatherScatterMode.CLIP)
 
 
 def _select_alpha(st: LookupState, cfg: SwarmConfig):

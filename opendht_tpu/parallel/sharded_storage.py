@@ -96,7 +96,7 @@ def _route_back(resp: jax.Array, owner: jax.Array, pos: jax.Array,
 def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
                    capacity_factor: float, ids, tables_local,
                    alive, store_local: SwarmStore, keys, vals, seqs,
-                   key, now):
+                   sizes, ttls, key, now):
     """Per-shard announce: routed lookup, then routed store inserts."""
     found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
                                       ids, tables_local, alive, keys,
@@ -114,7 +114,8 @@ def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     rep = lambda a: jnp.repeat(a, quorum, axis=0)
     payload = jnp.concatenate(
         [local_row[:, None], _u2i(rep(keys)),
-         _u2i(rep(vals))[:, None], _u2i(rep(seqs))[:, None]], axis=1)
+         _u2i(rep(vals))[:, None], _u2i(rep(seqs))[:, None],
+         _u2i(rep(sizes))[:, None], _u2i(rep(ttls))[:, None]], axis=1)
 
     cap = _cap_for(q, n_shards, capacity_factor)
     rbuf, pos, sent = _route_out(payload, owner, ok, n_shards, cap)
@@ -123,12 +124,15 @@ def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     r_key = _i2u(rbuf[..., 1:1 + N_LIMBS]).reshape(-1, N_LIMBS)
     r_val = _i2u(rbuf[..., 1 + N_LIMBS]).reshape(-1)
     r_seq = _i2u(rbuf[..., 2 + N_LIMBS]).reshape(-1)
+    r_size = _i2u(rbuf[..., 3 + N_LIMBS]).reshape(-1)
+    r_ttl = _i2u(rbuf[..., 4 + N_LIMBS]).reshape(-1)
     m = r_node.shape[0]
     # req_put = flat request index → _store_insert's replica vector
     # becomes a per-request accept bit we can route back.
     store_local, acc = _store_insert(
         store_local, scfg, r_node, r_key, r_val, r_seq,
-        jnp.arange(m, dtype=jnp.int32), now)
+        jnp.arange(m, dtype=jnp.int32), now,
+        jnp.maximum(r_size, 1), r_ttl)
 
     back = _route_back(acc.reshape(n_shards, cap, 1), owner, pos, sent,
                        cap)
@@ -202,7 +206,7 @@ def _store_specs(mesh: Mesh) -> SwarmStore:
         keys=P(AXIS, None, None), vals=P(AXIS, None), seqs=P(AXIS, None),
         created=P(AXIS, None), used=P(AXIS, None), cursor=shd,
         lkeys=P(AXIS, None, None), lids=P(AXIS, None), lcursor=shd,
-        notified=P())
+        notified=P(), sizes=P(AXIS, None), ttls=P(AXIS, None))
 
 
 def shard_store(store: SwarmStore, mesh: Mesh) -> SwarmStore:
@@ -219,27 +223,36 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                      scfg: StoreConfig, keys: jax.Array,
                      vals: jax.Array, seqs: jax.Array, now,
                      key: jax.Array, mesh: Mesh,
-                     capacity_factor: float = 4.0
+                     capacity_factor: float = 4.0,
+                     sizes: jax.Array | None = None,
+                     ttls: jax.Array | None = None
                      ) -> Tuple[SwarmStore, AnnounceReport]:
     """Batched put over the sharded swarm + store.
 
-    ``keys [P,5]`` / ``vals [P]`` / ``seqs [P]`` shard on the put axis;
-    store shards on the node axis; P and N must divide the mesh size.
-    ``now`` is traced (a changing sim-time must not recompile).
+    ``keys [P,5]`` / ``vals [P]`` / ``seqs [P]`` (and optional
+    per-value ``sizes``/``ttls``) shard on the put axis; store shards
+    on the node axis; P and N must divide the mesh size.  ``now`` is
+    traced (a changing sim-time must not recompile).
     """
     n_shards = mesh.shape[AXIS]
+    p = keys.shape[0]
+    if sizes is None:
+        sizes = jnp.ones((p,), jnp.uint32)
+    if ttls is None:
+        ttls = jnp.zeros((p,), jnp.uint32)
     specs = _store_specs(mesh)
     fn = jax.shard_map(
         partial(_announce_body, cfg, scfg, n_shards, capacity_factor),
         mesh=mesh,
         in_specs=(P(), P(AXIS, None, None), P(), specs, P(AXIS, None),
-                  P(AXIS), P(AXIS), P(), P()),
+                  P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
         out_specs=(specs, P(AXIS), P(AXIS), P(AXIS)),
         check_vma=False,
     )
     store, replicas, hops, done = fn(swarm.ids, swarm.tables,
                                      swarm.alive, store, keys, vals,
-                                     seqs, key, jnp.uint32(now))
+                                     seqs, sizes, ttls, key,
+                                     jnp.uint32(now))
     return store, AnnounceReport(replicas=replicas, hops=hops, done=done)
 
 

@@ -87,3 +87,21 @@ def test_cross_process_listen(duo):
         rr = child.request(op="poll_listen", token=token)
         return b"pushed" in rr["values"]
     assert wait_for(got_push, timeout=20)
+
+
+def test_proc_cluster_putget():
+    """4 OS processes, star-bootstrapped: a value put on one process is
+    retrievable from every other (ref cluster-manager behavior,
+    python/tools/dht/network.py:283-445)."""
+    from opendht_tpu.harness.proc_node import ProcCluster
+
+    c = ProcCluster(4)
+    try:
+        assert c.wait_connected(min_good=1, timeout=60)
+        h = InfoHash.get("cluster-key")
+        assert c.put(1, bytes(h), b"cluster-value")
+        for i in (0, 2, 3):
+            vals = c.get(i, bytes(h))
+            assert b"cluster-value" in vals, (i, vals)
+    finally:
+        c.close()

@@ -291,3 +291,88 @@ class TestExpireRepublish:
                          jax.random.PRNGKey(27))
         # With 90% of nodes dead and no maintenance, most replicas die.
         assert np.asarray(res.hit).mean() < 0.9
+
+
+def test_byte_budget_rejects_oversize(small_swarm):
+    """Per-node byte budget (the scaled 64 MB max_store_size,
+    ref callbacks.h:72, storageStore src/dht.cpp:2227-2258): once a
+    node's stored bytes hit the budget, further new keys are
+    rejected even though slots remain."""
+    swarm, cfg = small_swarm
+    scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024,
+                       budget=10)
+    store = empty_store(cfg.n_nodes, scfg)
+    p = 32
+    keys = _rand_keys(40, p)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+    big = jnp.full((p,), 6, jnp.uint32)     # 2 values of size 6 > 10
+    store, rep = announce(swarm, cfg, store, scfg, keys, vals, seqs, 0,
+                          jax.random.PRNGKey(41), sizes=big)
+    # stored bytes per node never exceed the budget
+    node_bytes = np.asarray(
+        jnp.sum(jnp.where(store.used, store.sizes, 0), axis=1))
+    assert node_bytes.max() <= 10
+    # storing the same keys with size 1 accepts far more replicas
+    store2 = empty_store(cfg.n_nodes, scfg)
+    store2, rep2 = announce(swarm, cfg, store2, scfg, keys, vals, seqs,
+                            0, jax.random.PRNGKey(41))
+    assert float(np.asarray(rep2.replicas).mean()) \
+        > float(np.asarray(rep.replicas).mean())
+
+
+def test_per_value_ttl_expiry(small_swarm):
+    """Per-value TTLs (per-ValueType expiration, value.h:75-106):
+    short-lived values disappear at their own deadline while sibling
+    long-lived values survive."""
+    swarm, cfg = small_swarm
+    scfg = SCFG
+    store = empty_store(cfg.n_nodes, scfg)
+    p = 16
+    k_short, k_long = _rand_keys(50, p), _rand_keys(51, p)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+    store, _ = announce(swarm, cfg, store, scfg, k_short, vals, seqs, 0,
+                        jax.random.PRNGKey(52),
+                        ttls=jnp.full((p,), 5, jnp.uint32))
+    store, _ = announce(swarm, cfg, store, scfg, k_long, vals, seqs, 0,
+                        jax.random.PRNGKey(53),
+                        ttls=jnp.full((p,), 100, jnp.uint32))
+    store = expire(store, scfg, 10)   # past short ttl, before long
+    r_short = get_values(swarm, cfg, store, scfg, k_short,
+                         jax.random.PRNGKey(54))
+    r_long = get_values(swarm, cfg, store, scfg, k_long,
+                        jax.random.PRNGKey(55))
+    assert float(np.asarray(r_short.hit).mean()) == 0.0
+    assert float(np.asarray(r_long.hit).mean()) > 0.9
+
+
+def test_byte_budget_blocks_growing_refresh(small_swarm):
+    """A seq-refresh that would grow a stored value past the byte
+    budget is rejected; an in-budget refresh is accepted."""
+    swarm, cfg = small_swarm
+    scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024,
+                       budget=10)
+    store = empty_store(cfg.n_nodes, scfg)
+    p = 16
+    keys = _rand_keys(60, p)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+    store, _ = announce(swarm, cfg, store, scfg, keys, vals, seqs, 0,
+                        jax.random.PRNGKey(61),
+                        sizes=jnp.full((p,), 5, jnp.uint32))
+    # grow each value to size 100 with a higher seq: must be rejected
+    store, rep = announce(swarm, cfg, store, scfg, keys, vals + 7,
+                          seqs + 1, 1, jax.random.PRNGKey(61),
+                          sizes=jnp.full((p,), 100, jnp.uint32))
+    node_bytes = np.asarray(
+        jnp.sum(jnp.where(store.used, store.sizes, 0), axis=1))
+    assert node_bytes.max() <= 10
+    assert float(np.asarray(rep.replicas).sum()) == 0
+    # in-budget refresh (same size) is accepted
+    store, rep2 = announce(swarm, cfg, store, scfg, keys, vals + 9,
+                           seqs + 2, 2, jax.random.PRNGKey(61),
+                           sizes=jnp.full((p,), 5, jnp.uint32))
+    assert float(np.asarray(rep2.replicas).mean()) > 3
+    r = get_values(swarm, cfg, store, scfg, keys, jax.random.PRNGKey(62))
+    assert bool(jnp.all(jnp.where(r.hit, r.val == vals + 9, True)))
