@@ -55,7 +55,8 @@ def main():
                          "use augmented tables within HBM")
     ap.add_argument("--repeat", type=int, default=3)
     ap.add_argument("--recall-sample", type=int, default=512)
-    ap.add_argument("--mode", choices=("lookups", "putget", "churn"),
+    ap.add_argument("--mode",
+                    choices=("lookups", "putget", "churn", "crawl"),
                     default="lookups")
     ap.add_argument("--kill-frac", type=float, default=0.5,
                     help="fraction of nodes killed in --mode churn")
@@ -72,6 +73,8 @@ def main():
         return putget_main(args)
     if args.mode == "churn":
         return churn_main(args)
+    if args.mode == "crawl":
+        return crawl_main(args)
 
     from opendht_tpu.models.swarm import (
         SwarmConfig, build_swarm, lookup, true_closest,
@@ -295,6 +298,84 @@ def churn_main(args):
         "survival_before_republish": round(survival_no_repub, 4),
         "republish_wall_s": round(repub_s, 3),
         "values_intact": bool(ok_vals.all()),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+
+
+def crawl_main(args):
+    """Full-swarm crawl + signed-value verify throughput — the device
+    twin of dhtscanner (ref tools/dhtscanner.cpp:43-67: recursive gets
+    splitting the keyspace until bucket depth) plus the crawl's value
+    signature checking.
+
+    The crawl issues lookups on an evenly spaced keyspace grid ~2x
+    oversampled vs the node count; every answered lookup contributes
+    its quorum-closest discovered nodes.  Reported: coverage (fraction
+    of alive nodes discovered), crawl wall, nodes/s, and host-side
+    RSA signed-value verifies/s (the reference's scanner checks values
+    as it walks).
+    """
+    import math as _math
+
+    from opendht_tpu.models.swarm import SwarmConfig, build_swarm, lookup
+
+    n = args.nodes
+    kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    cfg = SwarmConfig.for_nodes(n, **kw)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    _ = np.asarray(swarm.tables[:1, :1])
+
+    d = max(1, int(_math.ceil(_math.log2(max(16, n // 4)))))
+    g = 1 << d
+    j = jnp.arange(g, dtype=jnp.uint32)
+    grid = jnp.stack([
+        j << jnp.uint32(32 - d),
+        *[jnp.full((g,), jnp.uint32(0x80000000)) for _ in range(4)],
+    ], axis=1)                                             # [G,5]
+    lb = args.lookup_batch or g
+    chunks = [grid[lo:lo + lb] for lo in range(0, g, lb)]
+
+    def crawl_once(seed):
+        rs = [lookup(swarm, cfg, c, jax.random.PRNGKey(seed + i))
+              for i, c in enumerate(chunks)]
+        for r in rs:
+            _ = int(np.asarray(jnp.sum(r.found[:8])))
+        return rs
+
+    crawl_once(1)  # warmup
+    t0 = time.perf_counter()
+    rs = crawl_once(100)
+    dt = time.perf_counter() - t0
+    found = np.concatenate([np.asarray(r.found) for r in rs])
+    uniq = np.unique(found[found >= 0])
+    coverage = len(uniq) / n
+
+    # Signed-value verify throughput (host crypto path).
+    from opendht_tpu.core.value import Value
+    from opendht_tpu.crypto.identity import generate_identity
+    from opendht_tpu.crypto.securedht import (check_value_signature,
+                                              sign_value)
+
+    ident = generate_identity("crawler", key_length=2048)
+    v = Value(b"x" * 64, value_id=1)
+    sign_value(ident.key, v)
+    reps = 500
+    t1 = time.perf_counter()
+    okc = sum(check_value_signature(v) for _ in range(reps))
+    vps = reps / (time.perf_counter() - t1)
+    assert okc == reps
+
+    out = {
+        "metric": "swarm_crawl_coverage",
+        "value": round(coverage, 4),
+        "unit": "fraction",
+        "vs_baseline": round(coverage, 4),
+        "n_nodes": n,
+        "grid_lookups": g,
+        "crawl_wall_s": round(dt, 3),
+        "nodes_per_sec": round(len(uniq) / dt, 1),
+        "verifies_per_sec_rsa2048": round(vps, 1),
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(out))

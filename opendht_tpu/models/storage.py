@@ -240,20 +240,33 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
 
     first = jnp.searchsorted(s_node_sk, s_node_sk, side="left")
 
-    # --- update path (edit policy: seq must not decrease)
+    # --- edit policy (seq must not decrease) and new-key candidacy
     cur_seq = store.seqs[n_safe, mslot]
     upd = live & has_match & (s_seq >= cur_seq)
+    new = live & ~has_match
     if scfg.budget:
-        # A refresh may grow the value: enforce the byte cap on the
-        # size delta too (per-request against the pre-batch total —
-        # concurrent same-node updates in one batch are each checked
-        # against that same base, a documented approximation).
+        # Byte budget (the reference's max_store_size rejection,
+        # storageStore src/dht.cpp:2227-2258): stored bytes on the
+        # node plus this batch's earlier-ranked *growth* — new-key
+        # bytes and growing-refresh deltas share ONE per-segment
+        # prefix sum, so their combined accepts can never sum past the
+        # cap.  Conservative on purpose: growth of rows later rejected
+        # still counts against successors (they retry next round), and
+        # shrinking refreshes are not credited in-batch.  A refinement
+        # that re-admits shadowed rows can overshoot the cap via
+        # mutually-blind re-accepts, and the cap is a hard invariant.
+        budget = jnp.int32(min(scfg.budget, INT32_MAX))
         node_bytes = jnp.sum(
             jnp.where(store.used, store.sizes, 0), axis=1)  # [N]
-        base = node_bytes[n_safe]
-        old_size = jnp.where(has_match, store.sizes[n_safe, mslot], 0)
-        upd = upd & (base - old_size + s_size
-                     <= jnp.uint32(scfg.budget))
+        base = node_bytes[n_safe].astype(jnp.int32)
+        old_size = jnp.where(has_match, store.sizes[n_safe, mslot],
+                             0).astype(jnp.int32)
+        delta = s_size.astype(jnp.int32) - old_size
+        growth = jnp.where(upd & (delta > 0), delta, 0) \
+            + jnp.where(new, s_size.astype(jnp.int32), 0)
+        cum = _segment_excl_sum(growth, first)
+        upd = upd & (base + cum + jnp.maximum(delta, 0) <= budget)
+        new = new & (base + cum + s_size.astype(jnp.int32) <= budget)
     un, us = jnp.where(upd, s_node, n_nodes), mslot
     vals = _pad1(store.vals).at[un, us].set(s_val)
     seqs = _pad1(store.seqs).at[un, us].set(s_seq)
@@ -262,18 +275,6 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     ttls = _pad1(store.ttls).at[un, us].set(s_ttl)
 
     # --- new-key path: ring-slot allocation, ≤ slots per node per batch
-    new = live & ~has_match
-    if scfg.budget:
-        # Byte budget: stored bytes on the node + this batch's
-        # earlier-ranked candidate bytes must leave room.
-        # Conservative on purpose: a row rejected for size still
-        # counts against later rows this batch (they retry at the next
-        # announce/maintenance round).  A refinement that re-admits
-        # shadowed rows can overshoot the cap — mutually-blind
-        # re-accepts can sum past budget — and the cap is a hard
-        # invariant here, like the reference's storageStore rejection.
-        cum = _segment_excl_sum(jnp.where(new, s_size, 0), first)
-        new = new & (base + cum + s_size <= jnp.uint32(scfg.budget))
     rank = _segment_rank(s_node_sk, new, first)
     slot = ((store.cursor[n_safe] + rank.astype(jnp.uint32))
             % jnp.uint32(s)).astype(jnp.int32)

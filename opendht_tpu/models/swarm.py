@@ -288,17 +288,19 @@ def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     l = targets.shape[0]
     safe = jnp.clip(nid, 0, n - 1)
     c = prefix_len32(nid_d0)                                    # [L,A]
-    # One fetch per solicited node: buckets c and c+1 are adjacent
-    # rows, so gather a [2, width] slice starting at min(c, B-2) —
-    # random-gather cost is per fetch, not per byte.  (At the deepest
-    # bucket this returns rows B-2 and B-1 where the per-row form
-    # returned B-1 twice; a superset of candidates, same semantics.)
-    c0 = jnp.clip(c, 0, b_total - 2)
-    width = swarm.tables.shape[-1]
-    rows = _gather_rows2(swarm.tables, safe, c0)        # [L,A,2,width]
-    rows0, rows1 = rows[..., 0, :], rows[..., 1, :]
     ok = (nid >= 0) & swarm.alive[safe]
-    if width == 2 * k:                                      # augmented
+    if swarm.tables.shape[-1] == 2 * k:                     # augmented
+        # One fetch per solicited node: buckets c and c+1 are adjacent
+        # rows, so gather a [2, 2K] slice starting at min(c, B-2) —
+        # random-gather cost is per fetch, not per byte.  (At the
+        # deepest bucket this returns rows B-2 and B-1 where the
+        # per-row form returned B-1 twice; a candidate superset, same
+        # semantics.)  Plain tables stay on per-row gathers: on
+        # multi-GB tables XLA has been seen satisfying this gather's
+        # layout with a full padded transposed copy of the operand.
+        c0 = jnp.clip(c, 0, b_total - 2)
+        rows = _gather_rows2(swarm.tables, safe, c0)     # [L,A,2,2K]
+        rows0, rows1 = rows[..., 0, :], rows[..., 1, :]
         resp = jnp.concatenate([rows0[..., :k], rows1[..., :k]],
                                axis=-1)
         resp = jnp.where(ok[..., None], resp, -1).reshape(l, -1)
@@ -308,6 +310,10 @@ def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         d0 = m0.reshape(l, -1) ^ targets[:, 0][:, None]
         d0 = jnp.where(resp < 0, jnp.uint32(UINT32_MAX), d0)
     else:
+        c0 = jnp.clip(c, 0, b_total - 1)
+        c1 = jnp.clip(c + 1, 0, b_total - 1)
+        rows0 = swarm.tables[safe, c0]                      # [L,A,K]
+        rows1 = swarm.tables[safe, c1]
         resp = jnp.concatenate([rows0, rows1], axis=-1)     # [L,A,2K]
         resp = jnp.where(ok[..., None], resp, -1).reshape(l, -1)
         d0 = _resp_dist(swarm.ids, cfg, targets, resp)

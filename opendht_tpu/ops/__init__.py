@@ -2,9 +2,12 @@
 
 from .xor_metric import (  # noqa: F401
     common_bits,
+    common_bits32,
     closest_nodes,
     closest_nodes_batched,
     merge_shortlists,
+    merge_shortlists_d0,
+    prefix_len32,
     sort_by_distance,
     xor_ids,
     xor_less,

@@ -184,3 +184,28 @@ def test_pallas_nearest_k_fewer_than_k_valid(rng):
         want = brute_closest(ids[:5], InfoHash.from_u32(targets[li]), 5)
         assert got[li, :5].tolist() == want
         assert got[li, 5:].tolist() == [-1, -1, -1]
+
+
+def test_merge_shortlists_d0_dedup_order_queried():
+    from opendht_tpu.ops import merge_shortlists_d0
+
+    d0 = jnp.asarray([[50, 10, 30, 10, 0xFFFFFFFF, 20]], jnp.uint32)
+    idx = jnp.asarray([[7, 3, 5, 3, -1, 9]], jnp.int32)
+    q = jnp.asarray([[False, False, True, True, False, False]])
+    f_idx, f_d0, f_q = merge_shortlists_d0(d0, idx, q, keep=4)
+    # ascending by d0, dup idx 3 collapsed, -1 absent
+    assert f_idx.tolist() == [[3, 9, 5, 7]]
+    assert f_d0.tolist() == [[10, 20, 30, 50]]
+    # the duplicate of idx 3 carried queried=True on one copy -> kept
+    assert f_q.tolist() == [[True, False, True, False]]
+
+
+def test_merge_shortlists_d0_pads_with_minus_one():
+    from opendht_tpu.ops import merge_shortlists_d0
+
+    d0 = jnp.asarray([[5, 0xFFFFFFFF, 0xFFFFFFFF]], jnp.uint32)
+    idx = jnp.asarray([[2, -1, -1]], jnp.int32)
+    q = jnp.zeros((1, 3), bool)
+    f_idx, f_d0, f_q = merge_shortlists_d0(d0, idx, q, keep=3)
+    assert f_idx.tolist() == [[2, -1, -1]]
+    assert not f_q[0, 1] and not f_q[0, 2]
