@@ -255,18 +255,27 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
         # shrinking refreshes are not credited in-batch.  A refinement
         # that re-admits shadowed rows can overshoot the cap via
         # mutually-blind re-accepts, and the cap is a hard invariant.
-        budget = jnp.int32(min(scfg.budget, INT32_MAX))
+        budget = jnp.int32(min(scfg.budget, INT32_MAX - 1))
+        # Clamp request sizes to budget+1 BEFORE the signed arithmetic:
+        # an oversize request then still surely fails its admit check,
+        # while a raw uint32 size ≥ 2^31 can no longer wrap negative
+        # and bypass the cap (and per-row growth ≤ budget+1 keeps the
+        # int32 segment prefix sum exact for any segment whose
+        # candidate growth stays below 2^31).
+        s_sz = jnp.minimum(
+            s_size, jnp.uint32(budget) + 1).astype(jnp.int32)
         node_bytes = jnp.sum(
             jnp.where(store.used, store.sizes, 0), axis=1)  # [N]
         base = node_bytes[n_safe].astype(jnp.int32)
+        # Stored sizes are ≤ budget by this same check's invariant.
         old_size = jnp.where(has_match, store.sizes[n_safe, mslot],
                              0).astype(jnp.int32)
-        delta = s_size.astype(jnp.int32) - old_size
+        delta = s_sz - old_size
         growth = jnp.where(upd & (delta > 0), delta, 0) \
-            + jnp.where(new, s_size.astype(jnp.int32), 0)
+            + jnp.where(new, s_sz, 0)
         cum = _segment_excl_sum(growth, first)
         upd = upd & (base + cum + jnp.maximum(delta, 0) <= budget)
-        new = new & (base + cum + s_size.astype(jnp.int32) <= budget)
+        new = new & (base + cum + s_sz <= budget)
     un, us = jnp.where(upd, s_node, n_nodes), mslot
     vals = _pad1(store.vals).at[un, us].set(s_val)
     seqs = _pad1(store.seqs).at[un, us].set(s_seq)

@@ -535,59 +535,6 @@ def _finalize(ids: jax.Array, st: LookupState,
     return jnp.where(f_q[:, :cfg.quorum], f_idx[:, :cfg.quorum], -1)
 
 
-def lookup_compact(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
-                   key: jax.Array, chunk: int = 4) -> LookupResult:
-    """Batched lookups with host-side active-set compaction.
-
-    Same result as :func:`lookup`, but every ``chunk`` rounds the
-    finished lookups are retired and the remainder re-packed into the
-    next power-of-two batch, so the long tail (a few slow lookups) no
-    longer pays full-batch cost per round.  Compile cache: one program
-    per power-of-two batch size.
-    """
-    import numpy as np
-
-    l = targets.shape[0]
-    origins = _sample_origins(key, swarm.alive, l)
-    st = lookup_init(swarm, cfg, targets, origins)
-
-    found = np.full((l, cfg.quorum), -1, np.int32)
-    hops = np.zeros((l,), np.int32)
-    done_out = np.zeros((l,), bool)
-    idx_map = np.arange(l)
-    total = 0
-    while total < cfg.max_steps and len(idx_map):
-        n = min(chunk, cfg.max_steps - total)
-        st = lookup_steps(swarm, cfg, st, n)
-        total += n
-        done = np.asarray(st.done)
-        live = idx_map >= 0
-        finished = (done | (total >= cfg.max_steps)) & live
-        if finished.any():
-            rows = idx_map[finished]
-            f = np.asarray(_finalize(swarm.ids, st, cfg))
-            found[rows] = f[finished]
-            hops[rows] = np.asarray(st.hops)[finished]
-            done_out[rows] = done[finished]
-        active = live & ~done & (total < cfg.max_steps)
-        n_act = int(active.sum())
-        if n_act == 0:
-            break
-        # Re-pack to the next power-of-two batch ≥ n_act (pad rows are
-        # duplicates of row 0 whose results are discarded via idx_map).
-        cap = max(256, 1 << (n_act - 1).bit_length())
-        if cap >= len(idx_map):
-            continue
-        sel = np.nonzero(active)[0]
-        pad = np.full(cap - n_act, sel[0], dtype=sel.dtype)
-        take = jnp.asarray(np.concatenate([sel, pad]))
-        st = jax.tree_util.tree_map(lambda a: a[take], st)
-        idx_map = np.concatenate(
-            [idx_map[sel], np.full(cap - n_act, -1, idx_map.dtype)])
-    return LookupResult(found=jnp.asarray(found), hops=jnp.asarray(hops),
-                        done=jnp.asarray(done_out))
-
-
 @partial(jax.jit, static_argnames=("cfg", "k"))
 def true_closest(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                  k: int = 8) -> jax.Array:

@@ -405,3 +405,22 @@ def test_byte_budget_in_batch_refresh_growth(small_swarm):
         jnp.sum(jnp.where(store.used[0], store.sizes[0], 0))))
     assert node_bytes <= 10, node_bytes
     assert int(_np.asarray(acc2).sum()) == 1         # one grew, one held
+
+
+def test_byte_budget_huge_size_cannot_wrap(small_swarm):
+    """A request size >= 2^31 must be rejected, not wrap negative and
+    bypass the cap."""
+    swarm, cfg = small_swarm
+    scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024,
+                       budget=10)
+    store = empty_store(cfg.n_nodes, scfg)
+    import numpy as _np
+    node = jnp.zeros((1,), jnp.int32)
+    keys = _rand_keys(80, 1)
+    store, acc = _store_insert(
+        store, scfg, node, keys, jnp.ones((1,), jnp.uint32),
+        jnp.ones((1,), jnp.uint32), jnp.zeros((1,), jnp.int32),
+        jnp.uint32(0), jnp.asarray([0x80000000], jnp.uint32),
+        jnp.zeros((1,), jnp.uint32))
+    assert int(_np.asarray(acc).sum()) == 0
+    assert not bool(_np.asarray(store.used[0]).any())
