@@ -63,6 +63,9 @@ def main():
     ap.add_argument("--zipf", type=float, default=0.0,
                     help="churn mode: draw gets Zipf(s)-skewed over "
                          "the put keyset (0 = uniform, one get/key)")
+    ap.add_argument("--rounds", type=lambda s: max(1, int(s)), default=1,
+                    help="churn mode: kill/republish cycles, min 1 "
+                         "(the mult_time persistence scenario)")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture an XLA profiler trace of one timed run")
     args = ap.parse_args()
@@ -264,18 +267,26 @@ def churn_main(args):
     else:
         get_keys = keys
 
-    dead = churn(swarm, jax.random.PRNGKey(3), args.kill_frac, cfg)
-    res_dead = get_values(dead, cfg, store, scfg, get_keys,
-                          jax.random.PRNGKey(4))
-    survival_no_repub = float(np.asarray(res_dead.hit).mean())
-
-    # Survivors republish everything they hold (storage maintenance).
-    t0 = time.perf_counter()
-    store, rrep = republish_from(dead, cfg, store, scfg,
-                                 jnp.arange(cfg.n_nodes, dtype=jnp.int32),
-                                 1, jax.random.PRNGKey(5))
-    _ = int(np.asarray(jnp.sum(rrep.replicas[:8])))
-    repub_s = time.perf_counter() - t0
+    # Repeated kill/republish cycles — one cycle is the delete
+    # scenario, several are mult_time (continuous churn with
+    # maintenance racing it, ref tests.py:439-827).  Each cycle kills
+    # kill_frac of the REMAINING nodes, then survivors republish.
+    dead = swarm
+    repub_s = 0.0
+    survival_no_repub = None
+    all_idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    for r in range(args.rounds):
+        dead = churn(dead, jax.random.PRNGKey(3 + 10 * r),
+                     args.kill_frac, cfg)
+        if survival_no_repub is None:
+            rd = get_values(dead, cfg, store, scfg, get_keys,
+                            jax.random.PRNGKey(4))
+            survival_no_repub = float(np.asarray(rd.hit).mean())
+        t0 = time.perf_counter()
+        store, rrep = republish_from(dead, cfg, store, scfg, all_idx,
+                                     1 + r, jax.random.PRNGKey(5 + r))
+        _ = int(np.asarray(jnp.sum(rrep.replicas[:8])))
+        repub_s += time.perf_counter() - t0
 
     res = get_values(dead, cfg, store, scfg, get_keys,
                      jax.random.PRNGKey(6))
@@ -294,6 +305,8 @@ def churn_main(args):
         "n_puts": p,
         "kill_frac": args.kill_frac,
         "zipf": args.zipf,
+        "rounds": args.rounds,
+        "alive_frac_final": float(np.asarray(dead.alive).mean()),
         "mean_replicas_before": round(pre_replicas, 2),
         "survival_before_republish": round(survival_no_repub, 4),
         "republish_wall_s": round(repub_s, 3),
