@@ -212,10 +212,23 @@ class ProcCluster:
 
     def wait_connected(self, min_good: int = 1,
                        timeout: float = 60.0) -> bool:
-        """Every node sees ≥ min_good good peers."""
+        """Every node sees ≥ min_good good peers.
+
+        A child process that died (or stopped answering) counts as
+        not-connected rather than raising an opaque TimeoutError out
+        of the poll loop — the caller sees a clean False.
+        """
         end = time.monotonic() + timeout
         while time.monotonic() < end:
-            stats = [n.request(op="stats") for n in self.nodes]
+            stats = []
+            for n in self.nodes:
+                if n.proc.poll() is not None:
+                    stats.append({"good": -1, "dead": True})
+                    continue
+                try:
+                    stats.append(n.request(op="stats", timeout=5))
+                except (TimeoutError, OSError):
+                    stats.append({"good": -1})
             if all(s.get("good", 0) >= min_good for s in stats):
                 return True
             time.sleep(0.2)
@@ -230,7 +243,19 @@ class ProcCluster:
         return list(r.get("values", []))
 
     def stats(self) -> List[dict]:
-        return [n.request(op="stats") for n in self.nodes]
+        """Per-node stats; a dead/unresponsive child reports an error
+        entry instead of blowing up the whole sweep."""
+        out = []
+        for n in self.nodes:
+            if n.proc.poll() is not None:
+                out.append({"error": "process exited",
+                            "returncode": n.proc.returncode})
+                continue
+            try:
+                out.append(n.request(op="stats", timeout=5))
+            except (TimeoutError, OSError) as e:
+                out.append({"error": f"{type(e).__name__}: {e}"})
+        return out
 
     def close(self) -> None:
         for n in self.nodes:

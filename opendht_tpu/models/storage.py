@@ -191,9 +191,13 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     first P rows).
 
     Semantics per request, mirroring ``Dht::storageStore`` +
-    ``secureType`` edit policy:
-    * key already stored on the node → overwrite iff ``seq >=`` stored
-      seq (refresh/edit), else reject;
+    ``secureType`` edit policy
+    (/root/reference/src/securedht.cpp:94-116):
+    * key already stored on the node → overwrite iff ``seq >`` stored
+      seq, or ``seq ==`` stored seq with the SAME value (a re-announce
+      refresh, possibly by a third party); an equal-seq edit with
+      different data is rejected — "sequence number must be
+      increasing";
     * new key → ring-slot insert (oldest evicted when full), at most
       ``slots`` new keys per node per batch (excess dropped), and —
       when ``scfg.budget`` is set — only while the node's stored bytes
@@ -240,9 +244,12 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
 
     first = jnp.searchsorted(s_node_sk, s_node_sk, side="left")
 
-    # --- edit policy (seq must not decrease) and new-key candidacy
+    # --- edit policy (monotone seq; equal seq only re-announces the
+    # --- same value, ref securedht.cpp:105-115) and new-key candidacy
     cur_seq = store.seqs[n_safe, mslot]
-    upd = live & has_match & (s_seq >= cur_seq)
+    cur_val = store.vals[n_safe, mslot]
+    upd = live & has_match & (
+        (s_seq > cur_seq) | ((s_seq == cur_seq) & (s_val == cur_val)))
     new = live & ~has_match
     if scfg.budget:
         # Byte budget (the reference's max_store_size rejection,

@@ -67,13 +67,19 @@ class SwarmConfig(NamedTuple):
     alpha: int = 4
     quorum: int = 8
     max_steps: int = 48
-    # Augment routing tables with their members' first id limbs
-    # ([N,B,K] uint32 alongside the index table).  TPU random gathers
-    # cost ~10 ns per *fetch* regardless of row width (measured v5e),
-    # so shipping each member's distance surrogate inside the already-
-    # fetched bucket row removes the dominant per-step gather (64
-    # scalar fetches/lookup → 0).  Costs one extra tables-sized array —
-    # for_nodes turns it off above 2M nodes where HBM gets tight.
+    # Augment routing tables with a 16-bit *window surrogate* of each
+    # member's first id limb (``[N,B,3K] uint16``: index lo half, index
+    # hi half, window).  TPU random gathers cost ~10 ns per *fetch*
+    # regardless of row width (measured v5e), so shipping each member's
+    # distance surrogate inside the already-fetched bucket row removes
+    # the dominant per-step gather (64 scalar fetches/lookup → 0).  The
+    # window stores bits [b, b+16) of the member's limb 0 for bucket b
+    # — the bits above it are shared with the owning node and
+    # reconstructed from the solicitation's own distance (_window_d0) —
+    # so the surrogate always carries ≥16 significant bits past the
+    # leading one at 6 B/entry instead of round 3's exact-limb
+    # 8 B/entry, which is what lets the fast path fit 10M nodes on a
+    # 16 GB chip (10.1 GB vs 13.4 GB).
     aug_tables: bool = True
 
     @classmethod
@@ -84,20 +90,43 @@ class SwarmConfig(NamedTuple):
         # up to 2^depth bins — 26 covers ~2^29 nodes, far past what a
         # chip holds.
         b = min(26, max(4, int(math.ceil(math.log2(max(16, n_nodes)))) - 3))
-        kw.setdefault("aug_tables", n_nodes <= 2_000_000)
+        k = kw.get("bucket_k", 8)
+        # Augmented while the table fits comfortably on one 16 GB chip
+        # (~11.5 GB leaves headroom for ids + 1M-lookup transients);
+        # the 10M-node north star (10.1 GB at B=21) stays on.
+        kw.setdefault("aug_tables", n_nodes * b * 3 * k * 2
+                      <= 11_500_000_000)
         return cls(n_nodes=n_nodes, n_buckets=b, **kw)
 
 
 class Swarm(NamedTuple):
     """Device-resident swarm state (a pytree of arrays).
 
-    ``tables`` layout depends on ``SwarmConfig.aug_tables``:
+    ``tables`` layout depends on ``SwarmConfig.aug_tables``.  It is
+    stored 2-D with buckets flattened row-contiguously — bucket ``b``
+    of node ``i`` is ``tables[i, b*W:(b+1)*W]`` — and, for augmented
+    tables, the row is PADDED UP TO A LANE MULTIPLE (128 u16).  Both
+    choices are dictated by measured TPU gather behavior (v5e, this
+    runtime): the ONLY fast dynamic fetch over a ~10 GB operand is the
+    classic embedding-style whole-row gather ``tables[idx]`` on a
+    lane-aligned 2-D array (~10 ns/row amortized).  Every alternative
+    loses by orders of magnitude: 3-D ``[N,B,W]`` slice gathers make
+    XLA materialize a transposed operand copy whose minor dim pads to
+    128 lanes (54 GB at 10M nodes — compile OOM), and any
+    variable-start or multi-element-slice gather (2-D spans, 1-D
+    windows) runs ~2.5 µs per index — the slow per-element path.  The
+    respond path therefore fetches each solicited node's ENTIRE row
+    and extracts the two-bucket window on-chip with a static-slice
+    select chain (:func:`_respond`).
 
-    * augmented (default): ``[N,B,2K] int32`` — per bucket row, the K
-      member indices followed by the K members' first id limbs
-      (uint32, bitcast to int32).  One fetch brings a candidate list
-      *and* its distance surrogates — see SwarmConfig.aug_tables.
-    * plain: ``[N,B,K] int32`` member indices only (-1 = empty).
+    * augmented (default): ``[N, pad128(B*3K)] uint16`` — per bucket
+      row, the K member indices' low halves, their high halves, then
+      the K members' 16-bit limb-0 windows (bits [b, b+16) for bucket
+      b, MSB-aligned; empty slot = index 0xFFFFFFFF → -1).  One row
+      fetch brings every bucket's candidate list *and* distance
+      surrogates — see SwarmConfig.aug_tables and :func:`_window_d0`.
+    * plain: ``[N, B*K] int32`` member indices only (-1 = empty);
+      fetched via span gathers — functional fallback, slow at scale.
     """
     ids: jax.Array     # [N,5] uint32, lexicographically sorted
     tables: jax.Array  # [N,B,K or 2K] int32 — see class docstring
@@ -189,57 +218,91 @@ def bucket_range(sorted_ids: jax.Array, node_ids: jax.Array,
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("cfg",))
+def _build_ids(key: jax.Array, cfg: SwarmConfig) -> jax.Array:
+    raw = jax.random.bits(key, (cfg.n_nodes, N_LIMBS), jnp.uint32)
+    limbs = tuple(raw[:, i] for i in range(N_LIMBS))
+    sorted_limbs = jax.lax.sort(limbs, num_keys=N_LIMBS)
+    return jnp.stack(sorted_limbs, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _build_bucket(tables: jax.Array, ids0: jax.Array, b: jax.Array,
+                  key: jax.Array, cfg: SwarmConfig) -> jax.Array:
+    """Fill bucket ``b`` (traced scalar) of every node's table.
+
+    Bucket ranges via prefix histograms, not binary search: in the
+    sorted id matrix every bucket's key-space is a dyadic interval
+    determined by the first d ≤ 32 bits (d = bucket depth + 1), so its
+    [lo, hi) is a pair of adjacent prefix-histogram cumsums — O(N) per
+    bucket with one small gather, where per-node binary search was
+    O(N log N) random gathers (and its unrolled HLO crashed the
+    compiler at 10M nodes).  ``b`` is traced (histogram padded to
+    ``2^B`` bins) so all buckets share ONE compiled program, and
+    ``tables`` is DONATED so the 10 GB buffer is updated in place —
+    an unrolled whole-build jit kept a second table-sized buffer live
+    and OOMed a 16 GB chip at 10M nodes.
+    """
+    n, b_total, k = cfg.n_nodes, cfg.n_buckets, cfg.bucket_k
+    assert b_total <= 26, "prefix histogram capped at 2^26 bins"
+    inclusive = b == b_total - 1
+    d = jnp.where(inclusive, b, b + 1)   # prefix depth of the interval
+    # d ≥ 1 always (b_total ≥ 4), so the shift stays < 32.
+    pref = (ids0 >> (jnp.uint32(32) - d.astype(jnp.uint32))
+            ).astype(jnp.int32)
+    counts = jnp.zeros((1 << b_total,), jnp.int32).at[pref].add(1)
+    bounds = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)])
+    p = jnp.where(inclusive, pref, pref ^ 1)   # own vs sibling interval
+    lo, hi = bounds[p], bounds[p + 1]
+    size = (hi - lo).astype(jnp.float32)
+    # Stratified samples across the range: bucket membership is
+    # uniform-random in the reference's steady state too.
+    u = jax.random.uniform(key, (n, k))
+    strat = (jnp.arange(k, dtype=jnp.float32)[None, :] + u) / k
+    samp = lo[:, None] + jnp.floor(
+        strat * size[:, None]).astype(jnp.int32)
+    samp = jnp.clip(samp, lo[:, None], hi[:, None] - 1)
+    samp = jnp.where((hi > lo)[:, None], samp, -1)       # [N,K]
+    if cfg.aug_tables:
+        # Fused u16 row [idx-lo K | idx-hi K | window K].  The window
+        # is bits [b, b+16) of the member's limb 0, MSB-aligned (see
+        # _window_d0); empty slots (-1) become 0xFFFF halves and
+        # reconstruct to -1.
+        m0 = ids0[jnp.clip(samp, 0, n - 1)]
+        s16 = ((m0 << b.astype(jnp.uint32)) >> jnp.uint32(16)
+               ).astype(jnp.uint16)
+        su = samp.astype(jnp.uint32)
+        samp = jnp.concatenate(
+            [(su & jnp.uint32(0xFFFF)).astype(jnp.uint16),
+             (su >> jnp.uint32(16)).astype(jnp.uint16),
+             s16], axis=-1)                              # [N,3K]
+    width = samp.shape[-1]
+    return jax.lax.dynamic_update_slice(
+        tables, samp, (jnp.int32(0), b * width))
+
+
 def build_swarm(key: jax.Array, cfg: SwarmConfig) -> Swarm:
     """Generate a random swarm with steady-state routing tables.
 
-    O(N·B·log N): per (node, bucket), one binary search for the bucket's
-    sorted range, then K stratified-uniform samples from it.
+    O(N·B) total: per bucket, one padded prefix histogram + K
+    stratified-uniform samples per node.  Not one monolithic jit —
+    the per-bucket program donates the table buffer so peak HBM stays
+    at tables + O(N·K) transients (see ``_build_bucket``).
     """
     n, b_total, k = cfg.n_nodes, cfg.n_buckets, cfg.bucket_k
     k_ids, k_samp = jax.random.split(key)
-    raw = jax.random.bits(k_ids, (n, N_LIMBS), jnp.uint32)
-    limbs = tuple(raw[:, i] for i in range(N_LIMBS))
-    sorted_limbs = jax.lax.sort(limbs, num_keys=N_LIMBS)
-    ids = jnp.stack(sorted_limbs, axis=-1)
-
-    # Bucket ranges via prefix histograms, not binary search: in the
-    # sorted id matrix every bucket's key-space is a dyadic interval
-    # determined by the first d ≤ 32 bits (d = bucket depth + 1), so
-    # its [lo, hi) is a pair of adjacent prefix-histogram cumsums —
-    # O(N) per bucket with one small gather, where per-node binary
-    # search was O(N log N) random gathers (and its unrolled HLO
-    # crashed the compiler at 10M nodes).
-    assert b_total <= 26, "prefix histogram capped at 2^26 bins"
+    ids = _build_ids(k_ids, cfg)
     ids0 = ids[:, 0]
-    width = 2 * k if cfg.aug_tables else k
-    tables = jnp.full((n, b_total, width), -1, jnp.int32)
+    if cfg.aug_tables:
+        # Row padded to a 128-lane multiple: lane-aligned rows are what
+        # keeps the whole-row gather on the fast path (Swarm docstring).
+        row_w = -(-(b_total * 3 * k) // 128) * 128
+        tables = jnp.full((n, row_w), 0xFFFF, jnp.uint16)
+    else:
+        tables = jnp.full((n, b_total * k), -1, jnp.int32)
     for b in range(b_total):
-        inclusive = b == b_total - 1
-        d = b if inclusive else b + 1   # prefix depth of the interval
-        pref = (ids0 >> jnp.uint32(32 - d)).astype(jnp.int32) \
-            if d else jnp.zeros((n,), jnp.int32)
-        counts = jnp.zeros((1 << d,), jnp.int32).at[pref].add(1)
-        bounds = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)])
-        p = pref if inclusive else pref ^ 1   # own vs sibling interval
-        lo, hi = bounds[p], bounds[p + 1]
-        size = (hi - lo).astype(jnp.float32)
-        # Stratified samples across the range: bucket membership is
-        # uniform-random in the reference's steady state too.
-        u = jax.random.uniform(jax.random.fold_in(k_samp, b), (n, k))
-        strat = (jnp.arange(k, dtype=jnp.float32)[None, :] + u) / k
-        samp = lo[:, None] + jnp.floor(
-            strat * size[:, None]).astype(jnp.int32)
-        samp = jnp.clip(samp, lo[:, None], hi[:, None] - 1)
-        samp = jnp.where((hi > lo)[:, None], samp, -1)   # [N,K]
-        if cfg.aug_tables:
-            # Fused row [idx K | member-limb K], filled per bucket so
-            # the peak stays at tables + one [N,2K] slice (a whole-
-            # table concat would transiently triple the footprint).
-            m0 = jax.lax.bitcast_convert_type(
-                ids0[jnp.clip(samp, 0, n - 1)], jnp.int32)
-            samp = jnp.concatenate([samp, m0], axis=-1)  # [N,2K]
-        tables = tables.at[:, b, :].set(samp)
+        tables = _build_bucket(tables, ids0, jnp.int32(b),
+                               jax.random.fold_in(k_samp, b), cfg=cfg)
     return Swarm(ids=ids, tables=tables, alive=jnp.ones((n,), bool))
 
 
@@ -275,67 +338,158 @@ def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     node's bucket ``c`` (every member strictly closer to the target
     than the node itself) plus bucket ``c+1``, the node's best
     approximation of "the 8 closest I know" (``Dht::onFindNode``
-    src/dht.cpp:3189-3200).  With augmented tables the distances ride
-    inside the bucket-row fetches (members' first limbs XOR the
-    target); otherwise they come from a per-candidate id gather — the
-    slow path, kept for swarms too big to afford the aug table.  Dead
-    or empty slots return -1 / all-ones.  ``answered`` is the delivery
-    mask: the local engine always delivers to live targets; the
-    sharded transport may drop over-capacity queries (they retry next
-    round).
+    src/dht.cpp:3189-3200).  With augmented tables the distances are
+    reconstructed from the 16-bit member windows riding inside the
+    bucket-row fetches (:func:`_window_d0`); otherwise they come from
+    a per-candidate id gather — the slow path, kept for swarms too big
+    to afford the aug table.  Dead or empty slots return -1 /
+    all-ones.  ``answered`` is the delivery mask: the local engine
+    always delivers to live targets; the sharded transport may drop
+    over-capacity queries (they retry next round).
     """
     n, b_total, k = cfg.n_nodes, cfg.n_buckets, cfg.bucket_k
     l = targets.shape[0]
     safe = jnp.clip(nid, 0, n - 1)
     c = prefix_len32(nid_d0)                                    # [L,A]
     ok = (nid >= 0) & swarm.alive[safe]
-    if swarm.tables.shape[-1] == 2 * k:                     # augmented
-        # One fetch per solicited node: buckets c and c+1 are adjacent
-        # rows, so gather a [2, 2K] slice starting at min(c, B-2) —
-        # random-gather cost is per fetch, not per byte.  (At the
-        # deepest bucket this returns rows B-2 and B-1 where the
-        # per-row form returned B-1 twice; a candidate superset, same
-        # semantics.)  Plain tables stay on per-row gathers: on
-        # multi-GB tables XLA has been seen satisfying this gather's
-        # layout with a full padded transposed copy of the operand.
-        c0 = jnp.clip(c, 0, b_total - 2)
-        rows = _gather_rows2(swarm.tables, safe, c0)     # [L,A,2,2K]
-        rows0, rows1 = rows[..., 0, :], rows[..., 1, :]
-        resp = jnp.concatenate([rows0[..., :k], rows1[..., :k]],
-                               axis=-1)
-        resp = jnp.where(ok[..., None], resp, -1).reshape(l, -1)
-        m0 = jax.lax.bitcast_convert_type(
-            jnp.concatenate([rows0[..., k:], rows1[..., k:]], axis=-1),
-            jnp.uint32)
-        d0 = m0.reshape(l, -1) ^ targets[:, 0][:, None]
-        d0 = jnp.where(resp < 0, jnp.uint32(UINT32_MAX), d0)
+    if swarm.tables.dtype == jnp.uint16:                    # augmented
+        # One whole-row fetch per solicited node (the only fast gather
+        # over a 10 GB table — see the Swarm docstring), then the
+        # bucket-pair window [c0·3K, c0·3K+6K) is extracted on-chip by
+        # a B-way static-slice select chain (XLA fuses it into a
+        # single pass over the fetched rows).  At the deepest bucket
+        # this returns rows B-2 and B-1 where the per-row form
+        # returned B-1 twice; a candidate superset, same semantics.
+        rows = swarm.tables[safe.reshape(-1)]        # [Q, row_w] u16
+        c0f = jnp.clip(c, 0, b_total - 2).reshape(-1)        # [Q]
+        w3 = 3 * k
+        win = _select_pair_window(rows, c0f, w3, b_total)
+        idx, d0 = _unpack_pair_window(
+            win, c0f, c0f + 1, jnp.repeat(targets[:, 0], nid.shape[1]),
+            nid_d0.reshape(-1),
+            ok.reshape(-1), k)                       # [Q,2K] each
+        resp = idx.reshape(l, -1)
+        d0 = d0.reshape(l, -1)
     else:
         c0 = jnp.clip(c, 0, b_total - 1)
         c1 = jnp.clip(c + 1, 0, b_total - 1)
-        rows0 = swarm.tables[safe, c0]                      # [L,A,K]
-        rows1 = swarm.tables[safe, c1]
+        rows0 = _gather_span(swarm.tables, safe, c0 * k, k)  # [L,A,K]
+        rows1 = _gather_span(swarm.tables, safe, c1 * k, k)
         resp = jnp.concatenate([rows0, rows1], axis=-1)     # [L,A,2K]
         resp = jnp.where(ok[..., None], resp, -1).reshape(l, -1)
         d0 = _resp_dist(swarm.ids, cfg, targets, resp)
     return resp, d0, ok
 
 
-def _gather_rows2(tables: jax.Array, node: jax.Array,
-                  bucket: jax.Array) -> jax.Array:
-    """Gather ``tables[node, bucket:bucket+2, :]`` → ``[..., 2, W]``.
+def _select_pair_window(rows: jax.Array, c0: jax.Array, w3: int,
+                        b_total: int) -> jax.Array:
+    """Extract the adjacent bucket-pair window ``rows[q,
+    c0[q]·w3 : c0[q]·w3 + 2·w3]`` with a B-way static-slice select
+    chain (XLA fuses it into one pass over the fetched rows).
+    ``c0`` must be pre-clipped to ``[0, b_total-2]``."""
+    win = rows[:, 0:2 * w3]
+    for b in range(1, b_total - 1):
+        win = jnp.where((c0 == b)[:, None],
+                        rows[:, b * w3:b * w3 + 2 * w3], win)
+    return win
 
-    A single gather op with slice size 2 on the bucket axis — half the
-    fetches of two per-row gathers.  ``bucket`` must be ≤ B-2.
+
+def _unpack_pair_window(win: jax.Array, w0: jax.Array, w1: jax.Array,
+                        target0: jax.Array, nid_d0: jax.Array,
+                        okf: jax.Array, k: int):
+    """Decode a fetched bucket-pair window into candidates.
+
+    ``win [Q, 6K] uint16``: two bucket rows ``[lo K | hi K | s16 K]``
+    back to back; ``w0``/``w1`` ``[Q]``: the two rows' bucket depths
+    (= window starts); ``target0``/``nid_d0``/``okf`` ``[Q]``.
+    Returns ``(idx [Q,2K] int32, d0 [Q,2K] uint32)`` with invalid
+    slots -1 / all-ones.
+
+    All math runs on 1-D ``[Q]`` COLUMNS of the window, stacked on
+    axis 0 (``[2K, Q]`` — minor dim Q, pad-free) and transposed once
+    at the very end: any ``[.., 2, K]``- or ``[Q, small]``-shaped
+    intermediate acquires a TPU tiled layout whose minor dims pad to
+    (8·)128 lanes — measured 16-128× memory expansion per temp at
+    Q≥1M, which is what OOMed the 10M-node lookup step twice.  1-D
+    arrays tile flat and pad nothing; the single ``[Q, 2K]`` transpose
+    at the end is the one padded buffer this function pays for.
     """
-    b_total, w = tables.shape[1], tables.shape[2]
-    idx = jnp.stack([node, bucket], axis=-1)          # [..., 2]
+    idx_cols, d0_cols = [], []
+    for r, w in ((0, w0), (1, w1)):
+        base = r * 3 * k
+        for m in range(k):
+            lo = win[:, base + m].astype(jnp.uint32)
+            hi = win[:, base + k + m].astype(jnp.uint32)
+            s16 = win[:, base + 2 * k + m].astype(jnp.uint32)
+            idx_j = jax.lax.bitcast_convert_type(
+                lo | (hi << jnp.uint32(16)), jnp.int32)
+            valid = okf & (idx_j >= 0)
+            d0_j = _window_d0(s16, w, target0, nid_d0)
+            idx_cols.append(jnp.where(valid, idx_j, -1))
+            d0_cols.append(jnp.where(valid, d0_j,
+                                     jnp.uint32(UINT32_MAX)))
+    return (jnp.stack(idx_cols, axis=0).T,
+            jnp.stack(d0_cols, axis=0).T)
+
+
+def _window_d0(s16: jax.Array, w: jax.Array, target0: jax.Array,
+               nid_d0: jax.Array) -> jax.Array:
+    """Approximate first-limb XOR distance from a 16-bit member window.
+
+    A bucket-``b`` table row stores, per member, bits ``[b, b+16)`` of
+    the member's first id limb (MSB-aligned ``s16``).  Every bit above
+    the window is *shared with the owning node* — bucket members agree
+    with their node on all bits before the bucket depth — so those
+    distance bits equal the corresponding bits of ``nid_d0``, the
+    solicited node's own distance to the target, which the caller
+    already holds and whose leading ``clz+1`` bits are always exact
+    (``w ≤ clz(nid_d0)+1`` by construction of the two-row gather).
+    Bits below the window are unknown and read as zero.
+
+    The result is exact through bit ``w+16``: ≥16 significant bits
+    past the leading one, a 2⁻¹⁶ worst-case relative order error —
+    see the tie analysis in
+    :func:`opendht_tpu.ops.xor_metric.merge_shortlists_d0`.  A valid
+    reconstruction can never equal the 0xFFFFFFFF empty sentinel: the
+    sub-window bits are zero unless ``w+16 ≥ 32``, which needs
+    ``w ≥ 16``, while an all-ones prefix forces ``clz(nid_d0)=0`` and
+    hence ``w ≤ 1``.
+
+    Args broadcast together; ``w`` is the window start (= bucket
+    index), int32.
+    """
+    wu = jnp.clip(w, 0, 31).astype(jnp.uint32)
+    t16 = (target0 << wu) >> jnp.uint32(16)
+    d16 = s16 ^ t16
+    lsh = jnp.clip(16 - w, 0, 16).astype(jnp.uint32)
+    rsh = jnp.clip(w - 16, 0, 16).astype(jnp.uint32)
+    placed = jnp.where(w <= 16, d16 << lsh, d16 >> rsh)
+    hm = jnp.where(
+        w > 0,
+        jnp.uint32(UINT32_MAX)
+        << jnp.clip(32 - w, 0, 31).astype(jnp.uint32),
+        jnp.uint32(0))
+    return (nid_d0 & hm) | placed
+
+
+def _gather_span(tables: jax.Array, node: jax.Array, start: jax.Array,
+                 width: int) -> jax.Array:
+    """Gather ``tables[node, start:start+width]`` → ``[..., width]``.
+
+    One gather op fetching a contiguous ``width``-element span of the
+    2-D row-contiguous table per (node, start) pair — the adjacent-
+    buckets fetch is a single span, half the fetches of two per-row
+    gathers, and layout-aligned with the table's minor dimension (no
+    transposed operand copy — see the ``Swarm`` docstring).
+    """
+    idx = jnp.stack([node, start], axis=-1)           # [..., 2]
     return jax.lax.gather(
         tables, idx,
         jax.lax.GatherDimensionNumbers(
-            offset_dims=(node.ndim, node.ndim + 1),
+            offset_dims=(node.ndim,),
             collapsed_slice_dims=(0,),
             start_index_map=(0, 1)),
-        slice_sizes=(1, 2, w),
+        slice_sizes=(1, width),
         mode=jax.lax.GatherScatterMode.CLIP)
 
 
@@ -455,19 +609,26 @@ def _local_respond(swarm: Swarm, cfg: SwarmConfig):
 @partial(jax.jit, static_argnames=("l",))
 def _sample_origins(key: jax.Array, alive: jax.Array,
                     l: int) -> jax.Array:
-    """Uniform random *alive* origin per lookup.
+    """Uniform random *alive* origin per lookup — exact masked sampling.
 
-    Two-draw rejection with a first-alive fallback — O(L) memory.
-    (A categorical over the alive mask materializes an [L, N] gumbel
-    plane when not fused: 372 GB at L=100k, N=1M.)
+    Inverse-CDF over the alive mask: one [N] cumsum + L binary
+    searches, O(N + L·log N) time, O(N+L) memory.  (A categorical over
+    the alive mask materializes an [L,N] gumbel plane when not fused —
+    372 GB at L=100k, N=1M.  The former two-draw rejection fell back
+    to ONE fixed node with probability kill_frac² per lookup: at the
+    mult_time bench's 66 % cumulative death, ~44 % of maintenance
+    lookups originated from a single node, skewing hop counts and
+    localized-damage survival.)
     """
     n = alive.shape[0]
-    c1 = jax.random.randint(key, (l,), 0, n, jnp.int32)
-    c2 = jax.random.randint(jax.random.fold_in(key, 1), (l,), 0, n,
-                            jnp.int32)
-    first_alive = jnp.argmax(alive).astype(jnp.int32)
-    return jnp.where(alive[c1], c1,
-                     jnp.where(alive[c2], c2, first_alive))
+    cum = jnp.cumsum(alive.astype(jnp.int32))                  # [N]
+    total = cum[-1]
+    u = jax.random.randint(key, (l,), 0, jnp.maximum(total, 1),
+                           jnp.int32)
+    # First index whose cumulative alive-count exceeds u = the
+    # (u+1)-th alive node; clip only guards the all-dead degenerate.
+    return jnp.clip(jnp.searchsorted(cum, u, side="right"),
+                    0, n - 1).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -484,7 +645,6 @@ def lookup_step(swarm: Swarm, cfg: SwarmConfig,
                      cfg, st)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
 def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
            key: jax.Array) -> LookupResult:
     """Run a batch of iterative lookups to completion.
@@ -492,16 +652,36 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     ``targets``: ``[L,5]``.  Origins are random alive nodes (each
     lookup is issued "from" a random participant, like the scenario
     tests' random-node gets, python/tools/dht/tests.py:865-950).
+
+    The round loop runs on the HOST: a device-side ``lax.while_loop``
+    threads every captured array through the loop state, and XLA
+    materializes a full copy of the multi-GB routing table for that —
+    at 10M nodes a second 10 GB buffer that OOMs the chip.  Rounds are
+    dispatched in BURSTS with a done-check only between bursts: each
+    scalar readback through the device tunnel costs ~100 ms, so a
+    per-round check would serialize the loop on round-trips, while
+    burst dispatches pipeline back-to-back on the device.  Finished
+    lookups are frozen inside ``lookup_step``, so overshooting the
+    convergence round by a few bursts is wall-clock waste only, never
+    a semantics change.
     """
     l = targets.shape[0]
     # Origins are drawn from *alive* nodes: the issuing node exists.
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
-
-    def cond(st):
-        return ~jnp.all(st.done) & (jnp.max(st.hops) < cfg.max_steps)
-
-    st = jax.lax.while_loop(cond, lambda s: lookup_step(swarm, cfg, s), st)
+    # Typical convergence depth ≈ log2(N)/log2(2K) solicitation rounds
+    # plus tail; start with one burst of that size, then probe in 2s.
+    burst = min(cfg.max_steps,
+                max(6, int(math.log2(max(2, cfg.n_nodes)) / 4) + 5))
+    rounds = 0
+    while rounds < cfg.max_steps:
+        n = min(burst, cfg.max_steps - rounds)
+        for _ in range(n):
+            st = lookup_step(swarm, cfg, st)
+        rounds += n
+        if bool(jnp.all(st.done)):
+            break
+        burst = 2
     return LookupResult(found=_finalize(swarm.ids, st, cfg),
                         hops=st.hops, done=st.done)
 

@@ -246,15 +246,30 @@ def merge_shortlists_d0(cand_d0: jax.Array, cand_idx: jax.Array,
                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Surrogate-distance merge + dedup for the lookup hot loop.
 
-    Candidates carry only the first 32 XOR-distance bits
-    (``d0 = limb0(id ^ target)``); order is ``(d0, idx)``.  For uniform
-    ids two *distinct* shortlist candidates collide on d0 with
-    probability ≈ C²/2³³ per merge, so the order differs from the exact
-    160-bit order (``Search::insertNode``, src/dht.cpp:961-1047)
-    immeasurably rarely, and the final result is re-sorted exactly once
-    per lookup (``models.swarm._finalize``).  What IS exact here is the
-    dedup — same node ⇔ same index, so duplicates are found by ``idx``
-    equality, with queried copies winning.
+    Candidates carry only (an approximation of) the first 32
+    XOR-distance bits (``d0 = limb0(id ^ target)``).  Two passes, both
+    fixed-width ``lax.sort``:
+
+    1. group by node index, queried copies first within a group —
+       duplicates become adjacent *regardless of their d0 values*.
+       The same node legitimately arrives with different d0s when d0
+       is a 16-bit window surrogate reconstructed at different bucket
+       depths (``models.swarm._window_d0``), so dedup must never rely
+       on equal keys the way an id-sorted merge could;
+    2. order the survivors by d0, duplicates and empties pushed back.
+
+    Order error vs the exact 160-bit merge (``Search::insertNode``,
+    src/dht.cpp:961-1047): two *distinct* candidates tie on d0 with
+    probability ≈ 2⁻³³ per pair for exact d0, ≈ 2⁻¹⁷ per pair for
+    window surrogates (≥16 significant bits past the leading one);
+    either way the final result is re-sorted exactly once per lookup
+    (``models.swarm._finalize``).  Sentinel note: an empty slot's key
+    is all-ones, so a *live* candidate whose exact d0 is 0xFFFFFFFF
+    (probability 2⁻³² per candidate) sorts among the invalid entries —
+    it can at worst trigger a premature exhaustion-done on that one
+    lookup; window-surrogate d0s can never take the sentinel value
+    (their sub-window bits read as zero while their leading bits can
+    only be all-ones when the window starts at bit 0).
 
     The payoff vs the former 5-limb merge: no ``[..., 5]``-minor arrays
     (which tile onto TPU lanes at 5/128 utilisation) and 2 sorts of 3-4
@@ -265,24 +280,22 @@ def merge_shortlists_d0(cand_d0: jax.Array, cand_idx: jax.Array,
     """
     maxu = jnp.uint32(0xFFFFFFFF)
     d0 = jnp.where(cand_idx < 0, maxu, cand_d0)
-    # -1 becomes 0xFFFFFFFF and sorts last among equal d0; bitcast back
-    # below recovers the int32 index for free.
+    # -1 becomes 0xFFFFFFFF and groups/sorts last; bitcast back below
+    # recovers the int32 index for free.
     idx_u = cand_idx.astype(jnp.uint32)
     inv_q = (~cand_queried).astype(jnp.uint32)
-    s_d0, s_idx_u, _, s_q = jax.lax.sort(
-        (d0, idx_u, inv_q, cand_queried), dimension=1, num_keys=3,
-        is_stable=False)
+    s_idx_u, _, s_d0, s_q = jax.lax.sort(
+        (idx_u, inv_q, d0, cand_queried), dimension=1, num_keys=2,
+        is_stable=True)
     s_idx = s_idx_u.astype(jnp.int32)
 
-    prev = jnp.roll(s_idx, 1, axis=1)
-    dup = s_idx == prev
+    prev = jnp.roll(s_idx_u, 1, axis=1)
+    dup = s_idx_u == prev
     dup = dup.at[:, 0].set(False)
     dup = dup | (s_idx < 0)
-    s_idx = jnp.where(dup, -1, s_idx)
-    d0_2 = jnp.where(dup, maxu, s_d0)
     f_d0, f_idx_u, f_q = jax.lax.sort(
-        (d0_2, jnp.where(dup, maxu, s_idx_u), s_q), dimension=1,
-        num_keys=1, is_stable=True)
+        (jnp.where(dup, maxu, s_d0), jnp.where(dup, maxu, s_idx_u), s_q),
+        dimension=1, num_keys=1, is_stable=True)
     f_idx = f_idx_u.astype(jnp.int32)
     f_q = f_q & (f_idx >= 0)
     return f_idx[:, :keep], f_d0[:, :keep], f_q[:, :keep]

@@ -35,6 +35,12 @@ from ..models.swarm import (
     Swarm,
     SwarmConfig,
     _finalize,
+    _gather_span,
+    _local_respond,
+    _sample_origins,
+    _select_alpha,
+    _select_pair_window,
+    _unpack_pair_window,
     init_impl,
     lookup,
     step_impl,
@@ -57,6 +63,21 @@ def data_parallel_lookup(swarm: Swarm, cfg: SwarmConfig,
 # ---------------------------------------------------------------------------
 # table-sharded mode
 # ---------------------------------------------------------------------------
+
+def _shard_positions(owner: jax.Array, ok: jax.Array,
+                     n_shards: int) -> jax.Array:
+    """Position of each query within its owner shard's capacity bucket.
+
+    Only real queries count — masked rows (-1) clip to node 0 and
+    would otherwise inflate shard 0's positions past capacity,
+    permanently starving genuine shard-0 traffic.
+    """
+    onehot = (owner[:, None] == jnp.arange(n_shards)[None, :]) \
+        & ok[:, None]
+    return jnp.take_along_axis(
+        jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1,
+        owner[:, None], axis=1)[:, 0]
+
 
 def _route_respond(tables_local: jax.Array, ids: jax.Array,
                    alive: jax.Array, targets: jax.Array, nid: jax.Array,
@@ -102,15 +123,7 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
     local_row = safe - owner * shard_n
     local_row = jnp.where(ok, local_row, -1)
 
-    # Position of each query within its owner's capacity-C bucket.
-    # Only real queries count — masked rows (-1) clip to node 0 and
-    # would otherwise inflate shard 0's positions past capacity,
-    # permanently starving genuine shard-0 traffic.
-    onehot = (owner[:, None] == jnp.arange(n_shards)[None, :]) \
-        & ok[:, None]
-    pos = jnp.take_along_axis(
-        jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1,
-        owner[:, None], axis=1)[:, 0]
+    pos = _shard_positions(owner, ok, n_shards)
     sent = ok & (pos < cap)
 
     # One stacked [D, C, 3] shuffle instead of three collectives: the
@@ -128,21 +141,46 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
     r_c0 = jnp.clip(r_c0, 0, cfg.n_buckets - 1)
     r_c1 = jnp.clip(r_c1, 0, cfg.n_buckets - 1)
 
-    # Owner-side gather of the two bucket rows.  Augmented tables
-    # ([.., idx K | m0 K]) ship back as-is; plain tables (swarms too
-    # big to afford the aug copy even when sharded) get the member
-    # limbs from an owner-side id gather — slower, but the id matrix
-    # is replicated, so it stays local.
+    # Owner-side fetch of the two bucket rows.  Augmented tables: one
+    # whole-row gather per query (the only fast gather over a big
+    # table — see the Swarm docstring) + a B-way static-slice window
+    # select; the [.., lo K | hi K | s16 K] u16 windows ship back —
+    # HALF the return bytes of round 3's exact-limb i32 rows.  Plain
+    # tables (fallback) span-gather and get the member limbs from an
+    # owner-side id gather — the id matrix is replicated, so it stays
+    # local.
     k = cfg.bucket_k
     safe_row = jnp.clip(r_row, 0, shard_n - 1)
-    rows0 = tables_local[safe_row, r_c0]                     # [D,C,K|2K]
-    rows1 = tables_local[safe_row, r_c1]
-    if tables_local.shape[-1] == k:                          # plain
-        m0 = jax.lax.bitcast_convert_type(ids[:, 0][jnp.clip(
-            jnp.concatenate([rows0, rows1], axis=-1), 0, n - 1)],
-            jnp.int32)
-        rows0 = jnp.concatenate([rows0, m0[..., :k]], axis=-1)
-        rows1 = jnp.concatenate([rows1, m0[..., k:]], axis=-1)
+    if tables_local.dtype == jnp.uint16:                     # augmented
+        # Same whole-row fetch + adjacent-pair select as the local
+        # engine (clip to B-2: at the deepest bucket rows B-2 and B-1
+        # come back, a candidate superset — identical semantics).
+        w3 = 3 * k
+        rows = tables_local[safe_row.reshape(-1)]    # [D*C, row_w]
+        r_c0p = jnp.clip(r_c0, 0, cfg.n_buckets - 2).reshape(-1)
+        resp = _select_pair_window(
+            rows, r_c0p, w3, cfg.n_buckets).reshape(n_shards, cap,
+                                                    6 * k)
+        resp = jnp.where((r_row >= 0)[..., None], resp,
+                         jnp.uint16(0xFFFF))
+        back = a2a(resp)                                     # [D,C,6K]
+        mine = back[owner, jnp.clip(pos, 0, cap - 1)]        # [Q,6K]
+        # Window start = the pair start the owner selected — the
+        # origin applies the identical clip to its own c0, so no need
+        # to ship it back.
+        w0 = jnp.clip(c0, 0, cfg.n_buckets - 2)
+        t0 = jnp.repeat(targets[:, 0], a)                    # [Q]
+        r_idx, r_d0 = _unpack_pair_window(
+            mine, w0, w0 + 1, t0, nid_d0.reshape(-1), sent, k)
+        return (r_idx.reshape(ll, a * 2 * k),
+                r_d0.reshape(ll, a * 2 * k), sent.reshape(ll, a))
+    rows0 = _gather_span(tables_local, safe_row, r_c0 * k, k)
+    rows1 = _gather_span(tables_local, safe_row, r_c1 * k, k)
+    m0 = jax.lax.bitcast_convert_type(ids[:, 0][jnp.clip(
+        jnp.concatenate([rows0, rows1], axis=-1), 0, n - 1)],
+        jnp.int32)
+    rows0 = jnp.concatenate([rows0, m0[..., :k]], axis=-1)
+    rows1 = jnp.concatenate([rows1, m0[..., k:]], axis=-1)
     resp = jnp.concatenate([rows0, rows1], axis=-1)          # [D,C,4K]
     resp = jnp.where((r_row >= 0)[..., None], resp, -1)
 
@@ -218,10 +256,95 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     fn = jax.shard_map(
         partial(_sharded_body, cfg, n_shards, capacity_factor),
         mesh=mesh,
-        in_specs=(P(), P(AXIS, None, None), P(), P(AXIS, None), P()),
+        in_specs=(P(), P(AXIS, None), P(), P(AXIS, None), P()),
         out_specs=(P(AXIS, None), P(AXIS), P(AXIS)),
         check_vma=False,
     )
     found, hops, done = fn(swarm.ids, swarm.tables, swarm.alive, targets,
                            key)
     return LookupResult(found=found, hops=hops, done=done)
+
+
+# ---------------------------------------------------------------------------
+# single-chip emulation of sharded-transport contention
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit,
+         static_argnames=("cfg", "n_shards", "capacity_factor"))
+def contended_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
+                     key: jax.Array, n_shards: int,
+                     capacity_factor: float
+                     ) -> tuple[LookupResult, jax.Array, jax.Array]:
+    """Lookup batch under the sharded transport's bounded-capacity rule,
+    emulated with *logical* shards on one chip.
+
+    Nodes partition into ``n_shards`` contiguous owner ranges; each
+    round, solicitations route to their owner with per-shard capacity
+    ``C = capacity_factor · Q/S``; over-capacity queries drop (the
+    origin retries next round) exactly as in ``_route_respond`` — same
+    position arithmetic (``_shard_positions``), no collectives.  This
+    measures the *contention* consequences of the capacity rule
+    (drop fraction, convergence-round inflation under Zipf-skewed
+    targets) on real hardware without needing a real multi-chip mesh —
+    the in-sim analogue of the reference shedding load via its rate
+    limiter (/root/reference/include/opendht/network_engine.h:462).
+
+    Returns ``(result, dropped_queries, attempted_queries)`` — the
+    counters summed over all rounds.
+    """
+    n = cfg.n_nodes
+    shard_n = n // n_shards
+    q = targets.shape[0] * cfg.alpha
+    if math.isfinite(capacity_factor):
+        cap = min(q, max(cfg.alpha,
+                         int(math.ceil(q / n_shards * capacity_factor))))
+    else:
+        cap = q
+    base = _local_respond(swarm, cfg)
+
+    def sent_mask(nid):
+        flat = nid.reshape(-1)
+        safe = jnp.clip(flat, 0, n - 1)
+        # Same eligibility as _route_respond: dead-node solicitations
+        # never ship, so they must not consume capacity slots or count
+        # as attempts in the contention statistics.
+        ok = (flat >= 0) & swarm.alive[safe]
+        owner = jnp.clip(safe // shard_n, 0, n_shards - 1).astype(
+            jnp.int32)
+        pos = _shard_positions(owner, ok, n_shards)
+        return (ok & (pos < cap)).reshape(nid.shape), ok
+
+    def respond(tg, nid, nid_d0):
+        resp, d0, ans = base(tg, nid, nid_d0)
+        sent, _ = sent_mask(nid)
+        width = resp.shape[1] // nid.shape[1]
+        m = jnp.repeat(sent, width, axis=1)
+        return (jnp.where(m, resp, -1),
+                jnp.where(m, d0, jnp.uint32(0xFFFFFFFF)),
+                ans & sent)
+
+    origins = _sample_origins(key, swarm.alive, targets.shape[0])
+    st = init_impl(swarm.ids, base, cfg, targets, origins)
+
+    def cond(carry):
+        st, _, _ = carry
+        return ~jnp.all(st.done) & (jnp.max(st.hops) < cfg.max_steps)
+
+    def body(carry):
+        st, dropped, attempted = carry
+        # Same selection the step will make — counters see exactly the
+        # queries the capacity rule saw.
+        sel, _ = _select_alpha(st, cfg)
+        sel = jnp.where(st.done[:, None], -1, sel)
+        sent, ok = sent_mask(sel)
+        dropped += jnp.sum(ok.reshape(sel.shape) & ~sent)
+        attempted += jnp.sum(ok)
+        return (step_impl(swarm.ids, swarm.alive, respond, cfg, st),
+                dropped, attempted)
+
+    zero = jnp.int32(0)
+    st, dropped, attempted = jax.lax.while_loop(
+        cond, body, (st, zero, zero))
+    res = LookupResult(found=_finalize(swarm.ids, st, cfg),
+                       hops=st.hops, done=st.done)
+    return res, dropped, attempted

@@ -39,8 +39,10 @@ from ..models.storage import (
     GetResult,
     StoreConfig,
     SwarmStore,
+    _segment_rank,
     _store_insert,
     empty_store,
+    expire,
 )
 from ..models.swarm import Swarm, SwarmConfig
 from ..ops.xor_metric import N_LIMBS
@@ -93,14 +95,15 @@ def _route_back(resp: jax.Array, owner: jax.Array, pos: jax.Array,
     return jnp.where(sent[:, None], mine, -1)
 
 
-def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
-                   capacity_factor: float, ids, tables_local,
-                   alive, store_local: SwarmStore, keys, vals, seqs,
-                   sizes, ttls, key, now):
-    """Per-shard announce: routed lookup, then routed store inserts."""
-    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
-                                      ids, tables_local, alive, keys,
-                                      key)
+def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
+                   capacity_factor: float, alive,
+                   store_local: SwarmStore, found, keys, vals, seqs,
+                   sizes, ttls, now):
+    """Routed store-insert phase shared by announce and republish:
+    ship each (replica-target, key, val, seq, size, ttl) request to the
+    owning shard, apply it against the local store shard with the full
+    edit-policy/budget semantics of ``_store_insert``, and route the
+    accept bits back.  Returns ``(store_local, replicas [ll])``."""
     ll, quorum = found.shape
     shard_n = cfg.n_nodes // n_shards
     q = ll * quorum
@@ -144,6 +147,20 @@ def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     notified = jax.lax.pmax(
         store_local.notified.astype(jnp.int32), AXIS).astype(bool)
     store_local = store_local._replace(notified=notified)
+    return store_local, replicas
+
+
+def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
+                   capacity_factor: float, ids, tables_local,
+                   alive, store_local: SwarmStore, keys, vals, seqs,
+                   sizes, ttls, key, now):
+    """Per-shard announce: routed lookup, then routed store inserts."""
+    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
+                                      ids, tables_local, alive, keys,
+                                      key)
+    store_local, replicas = _insert_routed(
+        cfg, scfg, n_shards, capacity_factor, alive, store_local,
+        found, keys, vals, seqs, sizes, ttls, now)
     return store_local, replicas, hops, done
 
 
@@ -244,7 +261,7 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     fn = jax.shard_map(
         partial(_announce_body, cfg, scfg, n_shards, capacity_factor),
         mesh=mesh,
-        in_specs=(P(), P(AXIS, None, None), P(), specs, P(AXIS, None),
+        in_specs=(P(), P(AXIS, None), P(), specs, P(AXIS, None),
                   P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
         out_specs=(specs, P(AXIS), P(AXIS), P(AXIS)),
         check_vma=False,
@@ -267,7 +284,7 @@ def sharded_get(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     fn = jax.shard_map(
         partial(_get_body, cfg, scfg, n_shards, capacity_factor),
         mesh=mesh,
-        in_specs=(P(), P(AXIS, None, None), P(), specs, P(AXIS, None),
+        in_specs=(P(), P(AXIS, None), P(), specs, P(AXIS, None),
                   P()),
         out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
         check_vma=False,
@@ -281,3 +298,164 @@ def sharded_empty_store(n_nodes: int, scfg: StoreConfig,
                         mesh: Mesh) -> SwarmStore:
     """An empty store laid out over the mesh."""
     return shard_store(empty_store(n_nodes, scfg), mesh)
+
+
+# ---------------------------------------------------------------------------
+# storage maintenance on the mesh (republish / expire / listen)
+# ---------------------------------------------------------------------------
+
+def _republish_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
+                    capacity_factor: float, ids, tables_local, alive,
+                    store_local: SwarmStore, key, now):
+    """Per-shard maintenance sweep: every alive node OF THIS SHARD
+    re-announces everything it stores — routed lookup over the stored
+    keys, then the same routed insert phase as announce."""
+    shard_n = cfg.n_nodes // n_shards
+    me = jax.lax.axis_index(AXIS)
+    local_alive = jax.lax.dynamic_slice_in_dim(
+        alive, me * shard_n, shard_n)
+    ok = local_alive[:, None] & store_local.used      # [shard_n, S]
+    keys = store_local.keys.reshape(-1, N_LIMBS)
+    vals = store_local.vals.reshape(-1)
+    seqs = store_local.seqs.reshape(-1)
+    sizes = store_local.sizes.reshape(-1)
+    ttls = store_local.ttls.reshape(-1)
+    okf = ok.reshape(-1)
+
+    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
+                                      ids, tables_local, alive, keys,
+                                      key)
+    # Dead/empty source slots announce to no one.
+    found = jnp.where(okf[:, None], found, -1)
+    store_local, replicas = _insert_routed(
+        cfg, scfg, n_shards, capacity_factor, alive, store_local,
+        found, keys, vals, seqs, sizes, ttls, now)
+    return store_local, replicas, hops, done
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "scfg", "mesh", "capacity_factor"))
+def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                      scfg: StoreConfig, now, key: jax.Array,
+                      mesh: Mesh, capacity_factor: float = 4.0
+                      ) -> Tuple[SwarmStore, AnnounceReport]:
+    """Mesh-wide storage maintenance: every alive node re-announces its
+    stored values to the keys' current quorum-closest — the sharded
+    ``Dht::dataPersistence``/``maintainStorage``
+    (/root/reference/src/dht.cpp:2887-2947), restoring replication
+    after churn without leaving the mesh.  The maintenance lookup
+    batch is ``(N/D)·slots`` per shard; over-capacity requests drop
+    and are healed by the next sweep, like the reference's rate-limited
+    maintenance catching up over successive 10-min periods.
+    """
+    n_shards = mesh.shape[AXIS]
+    specs = _store_specs(mesh)
+    fn = jax.shard_map(
+        partial(_republish_body, cfg, scfg, n_shards, capacity_factor),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS, None), P(), specs, P(), P()),
+        out_specs=(specs, P(AXIS), P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    store, replicas, hops, done = fn(swarm.ids, swarm.tables,
+                                     swarm.alive, store, key,
+                                     jnp.uint32(now))
+    return store, AnnounceReport(replicas=replicas, hops=hops, done=done)
+
+
+def sharded_expire(store: SwarmStore, scfg: StoreConfig,
+                   now) -> SwarmStore:
+    """TTL sweep over the sharded store (``Storage::expire``,
+    /root/reference/src/dht.cpp:2361-2381).
+
+    Elementwise on every ``[N,S]`` leaf — XLA runs it shard-local with
+    zero communication under whatever ``NamedSharding`` the store
+    carries, so the single-chip ``expire`` IS the sharded one."""
+    return expire(store, scfg, now)
+
+
+def _listen_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
+                 capacity_factor: float, ids, tables_local, alive,
+                 store_local: SwarmStore, keys, reg_ids, key):
+    """Per-shard listen: routed lookup, then routed listener-table
+    inserts (ring slots, ≤ listen_slots per node per batch) — the
+    sharded ``Dht::storageAddListener``
+    (/root/reference/src/dht.cpp:2299-2322)."""
+    from ..models.storage import INT32_MAX, _pad1
+
+    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
+                                      ids, tables_local, alive, keys,
+                                      key)
+    ll, quorum = found.shape
+    shard_n = cfg.n_nodes // n_shards
+    q = ll * quorum
+    ls = scfg.listen_slots
+
+    flat = found.reshape(-1)
+    safe = jnp.clip(flat, 0, cfg.n_nodes - 1)
+    rid = jnp.repeat(reg_ids, quorum)
+    ok = (flat >= 0) & alive[safe] \
+        & (rid >= 0) & (rid < scfg.max_listeners)
+    owner = jnp.clip(safe // shard_n, 0, n_shards - 1).astype(jnp.int32)
+    local_row = jnp.where(ok, safe - owner * shard_n, -1)
+    payload = jnp.concatenate(
+        [local_row[:, None], _u2i(jnp.repeat(keys, quorum, axis=0)),
+         rid[:, None]], axis=1)
+
+    cap = _cap_for(q, n_shards, capacity_factor)
+    rbuf, pos, sent = _route_out(payload, owner, ok, n_shards, cap)
+
+    r_node = rbuf[..., 0].reshape(-1)
+    r_key = _i2u(rbuf[..., 1:1 + N_LIMBS]).reshape(-1, N_LIMBS)
+    r_id = rbuf[..., 1 + N_LIMBS].reshape(-1)
+    valid = r_node >= 0
+
+    node_sk = jnp.where(valid, r_node, INT32_MAX)
+    out = jax.lax.sort(
+        (node_sk,) + tuple(r_key[:, i] for i in range(N_LIMBS))
+        + (r_id, r_node),
+        dimension=0, num_keys=1, is_stable=True)
+    s_node_sk = out[0]
+    s_key = jnp.stack(out[1:1 + N_LIMBS], axis=-1)
+    s_id, s_node = out[1 + N_LIMBS], out[2 + N_LIMBS]
+    live = s_node >= 0
+    rank = _segment_rank(s_node_sk, live)
+    accept = live & (rank < ls)
+    rows = store_local.lkeys.shape[0]
+    n_safe = jnp.clip(s_node, 0, rows - 1)
+    slot = ((store_local.lcursor[n_safe] + rank.astype(jnp.uint32))
+            % jnp.uint32(ls)).astype(jnp.int32)
+    nn = jnp.where(accept, s_node, rows)
+    lkeys = _pad1(store_local.lkeys).at[nn, slot].set(s_key)[:-1]
+    lids = _pad1(store_local.lids).at[nn, slot].set(s_id)[:-1]
+    n_new = jnp.zeros_like(store_local.lcursor).at[
+        jnp.where(accept, s_node, 0)].add(accept.astype(jnp.uint32))
+    store_local = store_local._replace(
+        lkeys=lkeys, lids=lids, lcursor=store_local.lcursor + n_new)
+    return store_local, hops, done
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "scfg", "mesh", "capacity_factor"))
+def sharded_listen_at(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                      scfg: StoreConfig, keys: jax.Array,
+                      reg_ids: jax.Array, key: jax.Array, mesh: Mesh,
+                      capacity_factor: float = 4.0
+                      ) -> Tuple[SwarmStore, jax.Array]:
+    """Batched listen over the mesh: register listener ``reg_ids [P]``
+    for ``keys [P,5]`` at each key's quorum-closest nodes; subsequent
+    ``sharded_announce``/``sharded_republish`` of a key flip its
+    listeners' ``notified`` bits (merged mesh-wide via pmax)."""
+    n_shards = mesh.shape[AXIS]
+    specs = _store_specs(mesh)
+    fn = jax.shard_map(
+        partial(_listen_body, cfg, scfg, n_shards, capacity_factor),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS, None), P(), specs, P(AXIS, None),
+                  P(AXIS), P()),
+        out_specs=(specs, P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    store, hops, done = fn(swarm.ids, swarm.tables, swarm.alive, store,
+                           keys, reg_ids, key)
+    return store, done

@@ -312,7 +312,16 @@ class DhtRunner:
             if self._bootstrap_tries > BOOTSTRAP_MAX_TRIES:
                 # Give up: release the gate and wake the loop so gated
                 # ops run now (they will fail fast on the empty table).
+                # The give-up is permanent for this chain (deliberate
+                # divergence from the reference's retry-forever), so
+                # make it VISIBLE: log + fire the status callback so
+                # callers know to re-bootstrap() if the network heals.
                 self._bootstrapping = False
+                self.log.w("bootstrap gave up after %d fruitless "
+                           "rounds; call bootstrap() to retry",
+                           BOOTSTRAP_MAX_TRIES)
+                if self.on_status_changed:
+                    self.on_status_changed(self._status4, self._status6)
                 with self._cv:
                     self._cv.notify_all()
                 return

@@ -3,14 +3,19 @@
 SURVEY §7 promises the event-driven host core (core/dht.py over the
 virtual transport) and the lock-step device swarm (models/swarm) are
 two implementations of the same Kademlia spec (α=4, k=8, 14-node
-search sets).  This test runs random-key lookups through both at the
-same swarm size and asserts the observable behavior agrees:
+search sets).  Two legs:
 
-* recall of the true 8 XOR-closest nodes among each lookup's answered
-  set is high on both engines and within tolerance of each other;
-* lookup effort agrees: the host's solicitations-per-lookup / α
-  (= rounds, ref searchStep's α-window src/dht.cpp:1438-1449) is in
-  the same small band as the device engine's lock-step hop count.
+* **lookups** — 200 random-key gets through a 1024-node host cluster
+  vs a 1024-node device swarm: recall of the true 8 XOR-closest among
+  the answered sets is high on both and close between them, and the
+  lookup effort (the searching node's get/find solicitations / α =
+  rounds, ref ``searchStep``'s α-window src/dht.cpp:1438-1449) agrees
+  within a 1.5× band of the device engine's lock-step hop count;
+* **storage semantics** — the same put → stale-seq overwrite → fresh
+  overwrite sequence through both engines must produce identical
+  get-visible outcomes (monotone-seq edit policy, ref
+  ``SecureDht::secureType`` src/securedht.cpp:103-118; device twin
+  ``models/storage._store_insert``).
 """
 
 import numpy as np
@@ -23,8 +28,8 @@ from dht_harness import SimCluster
 from opendht_tpu.models.swarm import SwarmConfig, build_swarm, lookup
 from opendht_tpu.utils.infohash import InfoHash
 
-N_NODES = 48
-N_LOOKUPS = 24
+N_NODES = 1024
+N_LOOKUPS = 200
 
 
 def brute_closest(all_ids, target_bytes, k=8):
@@ -44,29 +49,39 @@ def recall_of(found_ids, all_ids, target_bytes, k=8):
 def host_cluster():
     c = SimCluster(N_NODES, seed=7)
     c.interconnect()
-    c.run(5.0)
+    # 30 virtual seconds: enough confirm/neighbourhood maintenance
+    # cycles (5-25 s cadence, ref src/dht.cpp:2991-3027) that the
+    # routing tables reach steady state — measured: host recall 0.904
+    # after 5 s, 0.990 after 30 s at 1024 nodes; the device engine
+    # *starts* from steady-state tables, so comparing before the host
+    # converges would conflate warmup with engine behavior.
+    c.run(30.0)
     yield c
 
 
 def host_lookup_stats(c):
     """Run N_LOOKUPS random gets through the host engine; collect
-    recall of answered node sets and solicitations-per-lookup."""
+    recall of answered node sets and solicitations-per-lookup.
+
+    Effort counts only the SEARCHING node's outbound get/find traffic
+    (iterative Kademlia: the search owner solicits, peers only reply),
+    so cluster-wide maintenance noise cannot inflate the round
+    estimate the way the old all-nodes sum did.
+    """
     rng = np.random.default_rng(3)
     all_ids = [d.myid for d in c.nodes]
     recalls, rounds = [], []
     for i in range(N_LOOKUPS):
         target = InfoHash(rng.bytes(20))
         src = c.nodes[int(rng.integers(len(c.nodes)))]
-        before = sum(n.engine.stats_out.get("get", 0)
-                     + n.engine.stats_out.get("find", 0)
-                     for n in c.nodes)
+        before = (src.engine.stats_out.get("get", 0)
+                  + src.engine.stats_out.get("find", 0))
         done = []
         src.get(target, lambda vs: True,
                 lambda ok, nodes: done.append([n.id for n in nodes]))
         c.run_until(lambda: done, timeout=60.0)
-        after = sum(n.engine.stats_out.get("get", 0)
-                    + n.engine.stats_out.get("find", 0)
-                    for n in c.nodes)
+        after = (src.engine.stats_out.get("get", 0)
+                 + src.engine.stats_out.get("find", 0))
         assert done, "host lookup did not complete"
         recalls.append(recall_of(done[0], all_ids, bytes(target)))
         # α solicitations per round → rounds ≈ sent / α
@@ -97,16 +112,111 @@ def test_host_device_conformance(host_cluster):
     h_recall, h_rounds = host_lookup_stats(host_cluster)
     d_recall, d_hops = device_lookup_stats()
 
-    # Both engines must find (nearly) all of the true 8-closest.
-    assert h_recall.mean() > 0.85, h_recall.mean()
-    assert d_recall.mean() > 0.85, d_recall.mean()
-    assert abs(h_recall.mean() - d_recall.mean()) < 0.15, (
+    # Both engines must find (nearly) all of the true 8-closest, and
+    # agree with each other.
+    assert h_recall.mean() > 0.9, h_recall.mean()
+    assert d_recall.mean() > 0.9, d_recall.mean()
+    assert abs(h_recall.mean() - d_recall.mean()) < 0.08, (
         h_recall.mean(), d_recall.mean())
 
-    # Effort: rounds-to-converge in the same small band.  At 48 nodes
-    # both engines should converge in a handful of rounds; allow a
-    # generous factor for the engines' different round semantics.
-    h_med, d_med = float(np.median(h_rounds)), float(np.median(d_hops))
-    assert d_med <= 12 and h_med <= 12, (h_med, d_med)
-    assert h_med <= 4 * max(d_med, 1) and d_med <= 4 * max(h_med, 1), (
-        h_med, d_med)
+    # Effort: mean rounds-to-converge within a 1.5× band — a device
+    # engine needing twice the host's rounds (or vice versa) fails.
+    h_eff, d_eff = float(h_rounds.mean()), float(np.asarray(d_hops,
+                                                            float).mean())
+    assert d_eff <= 12 and h_eff <= 12, (h_eff, d_eff)
+    assert h_eff <= 1.5 * max(d_eff, 1) and d_eff <= 1.5 * max(h_eff, 1), (
+        h_eff, d_eff)
+
+
+# ---------------------------------------------------------------------------
+# storage-semantics leg: same op sequence, both engines, same outcomes
+# ---------------------------------------------------------------------------
+
+# (seq, payload tag) steps applied in order to ONE key; expected
+# freshest replica payload after each step under the reference edit
+# policy (securedht.cpp:105-115): seq must increase; an equal-seq
+# announce is only a re-announce of the SAME data — equal seq with
+# different data is rejected; stale seq is rejected.
+SEQ_STEPS = [(5, 1), (4, 2), (6, 3), (6, 4), (2, 5), (7, 6)]
+SEQ_EXPECT = [1, 1, 3, 3, 3, 6]
+
+
+def test_storage_seq_semantics_host():
+    """Host engine: announce the SEQ_STEPS as SIGNED values through a
+    secure-node cluster and check the REPLICA STATE at the key's true
+    8 closest nodes after each step.
+
+    Signed values are the only values that carry ``seq`` on the wire
+    (to-sign form, ref value.h:424-441 — unsigned values drop it), and
+    the monotone-seq edit policy lives in ``SecureDht::secureType``
+    (src/securedht.cpp:94-116; ours
+    crypto/securedht.py ``secure_type``) — so this leg exercises the
+    REAL product path, not a test-local policy.  The get path dedups
+    by value id, so it cannot observe per-replica accept/reject; the
+    stored state can.  Putters are drawn from the key's FARTHEST
+    nodes: a putting node stores its own value locally without the
+    edit policy (ref Dht::put → storageStore, src/dht.cpp:1752), which
+    would otherwise alias the replica-state observation."""
+    from opendht_tpu.core.value import Value
+    from opendht_tpu.crypto.identity import generate_identity
+    from opendht_tpu.crypto.securedht import sign_value
+
+    c = SimCluster(0, seed=11)
+    for _ in range(16):
+        c.add_secure_node()
+    c.interconnect()
+    c.run(10.0)
+    author = generate_identity("author", key_length=2048)
+    key = InfoHash(b"\x42" * 20)
+    all_ids = [d.myid for d in c.nodes]
+    ranked = brute_closest(all_ids, bytes(key), len(all_ids))
+    closest, farthest = ranked[:8], ranked[8:]
+    seen = []
+    for step, (seq, tag) in enumerate(SEQ_STEPS):
+        v = Value(bytes([tag]), value_id=77)
+        v.seq = seq
+        sign_value(author.key, v)   # seq rides the signed wire form
+        done = []
+        putter = c.nodes[farthest[step % len(farthest)]]
+        putter.put(key, v, lambda ok, ns: done.append(ok))
+        c.run_until(lambda: done, timeout=60.0)
+        c.run(1.0)
+        state = []
+        for i in closest:
+            lv = c.nodes[i].get_local_by_id(key, 77)
+            if lv is not None:
+                state.append((lv.seq, lv.data[0]))
+        assert state, f"step {step}: no replica stored"
+        seen.append(max(state)[1])
+    assert seen == SEQ_EXPECT, seen
+
+
+def test_storage_seq_semantics_device():
+    """Device engine: the same SEQ_STEPS through models/storage must
+    produce the same get-visible sequence as the host engine — the
+    'one spec, two engines' claim enforced for storage, not just
+    lookups."""
+    from opendht_tpu.models.storage import (
+        StoreConfig, announce, empty_store, get_values,
+    )
+
+    cfg = SwarmConfig.for_nodes(1024)
+    sw = build_swarm(jax.random.PRNGKey(7), cfg)
+    scfg = StoreConfig(slots=8, listen_slots=2, max_listeners=64)
+    store = empty_store(cfg.n_nodes, scfg)
+    key5 = jax.random.bits(jax.random.PRNGKey(42), (1, 5), jnp.uint32)
+    seen = []
+    for step, (seq, tag) in enumerate(SEQ_STEPS):
+        store, _ = announce(sw, cfg, store, scfg, key5,
+                            jnp.asarray([tag], jnp.uint32),
+                            jnp.asarray([seq], jnp.uint32),
+                            step, jax.random.PRNGKey(100 + step))
+        res = get_values(sw, cfg, store, scfg, key5,
+                         jax.random.PRNGKey(200 + step))
+        assert bool(res.hit[0]), f"step {step}: value not found"
+        seen.append(int(res.val[0]))
+    # The device announce path has no origin-side probe suppression
+    # (every request reaches the replicas and is judged by the store's
+    # edit policy), so its freshest-replica outcomes must equal the
+    # host's replica-state outcomes step for step.
+    assert seen == SEQ_EXPECT, seen

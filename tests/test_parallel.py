@@ -93,7 +93,7 @@ def test_sharded_lookup_plain_tables():
 
     cfg = SwarmConfig.for_nodes(1024, aug_tables=False)
     sw = build_swarm(jax.random.PRNGKey(0), cfg)
-    assert sw.tables.shape[-1] == cfg.bucket_k
+    assert sw.tables.shape[-1] == cfg.n_buckets * cfg.bucket_k
     mesh = make_mesh(8)
     tg = jax.random.bits(jax.random.PRNGKey(1), (64, 5), jnp.uint32)
     res = sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2), mesh)
@@ -160,6 +160,86 @@ def test_sharded_putget_capacity_drops_retryable():
     ok = jnp.where(res.hit, res.val == vals, True)
     assert bool(jnp.all(ok))
     assert float(jnp.mean(res.hit)) > 0.5
+
+
+def test_sharded_republish_restores_replication_after_churn():
+    """Mesh-wide churn → sharded maintenance → survival: the sharded
+    dataPersistence (ref src/dht.cpp:2887-2947).  Killing half the
+    swarm loses replicas; a republish sweep from the surviving shards
+    must restore get-ability without leaving the mesh."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.models.swarm import churn
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_get,
+        sharded_republish,
+    )
+
+    cfg, sw, scfg, mesh, keys, vals, seqs = _mk_sharded_store_env()
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals, seqs,
+                                0, jax.random.PRNGKey(2), mesh,
+                                capacity_factor=float("inf"))
+    dead = churn(sw, jax.random.PRNGKey(7), 0.5, cfg)
+    store, rrep = sharded_republish(dead, cfg, store, scfg, 1,
+                                    jax.random.PRNGKey(8), mesh,
+                                    capacity_factor=float("inf"))
+    assert float(jnp.sum(rrep.replicas)) > 0
+    res = sharded_get(dead, cfg, store, scfg, keys,
+                      jax.random.PRNGKey(9), mesh,
+                      capacity_factor=float("inf"))
+    assert float(jnp.mean(res.hit)) > 0.9, float(jnp.mean(res.hit))
+    ok = jnp.where(res.hit, res.val == vals, True)
+    assert bool(jnp.all(ok))
+
+
+def test_sharded_expire_ttl_sweep():
+    """Per-value TTLs must expire on the sharded store exactly as on
+    the single-chip one (Storage::expire, src/dht.cpp:2361-2381)."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_expire,
+        sharded_get,
+    )
+
+    cfg, sw, scfg, mesh, keys, vals, seqs = _mk_sharded_store_env(p=64)
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    ttls = jnp.where(jnp.arange(64) < 32, 5, 1000).astype(jnp.uint32)
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals, seqs,
+                                0, jax.random.PRNGKey(2), mesh,
+                                capacity_factor=float("inf"), ttls=ttls)
+    store = sharded_expire(store, scfg, 100)
+    res = sharded_get(sw, cfg, store, scfg, keys, jax.random.PRNGKey(3),
+                      mesh, capacity_factor=float("inf"))
+    hit = np.asarray(res.hit)
+    assert not hit[:32].any(), "short-TTL values survived the sweep"
+    assert hit[32:].mean() > 0.9, "long-TTL values expired"
+
+
+def test_sharded_listen_notify_roundtrip():
+    """listen → announce → notified-bit push across the mesh (the
+    sharded storageAddListener/storageChanged/tellListener,
+    src/dht.cpp:2186-2225,2299-2322)."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_listen_at,
+    )
+
+    cfg, sw, scfg, mesh, keys, vals, seqs = _mk_sharded_store_env(p=64)
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    reg_ids = jnp.arange(64, dtype=jnp.int32)
+    store, done = sharded_listen_at(sw, cfg, store, scfg, keys, reg_ids,
+                                    jax.random.PRNGKey(2), mesh,
+                                    capacity_factor=float("inf"))
+    assert bool(jnp.all(done))
+    assert not bool(jnp.any(store.notified)), "notified before announce"
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals, seqs,
+                                0, jax.random.PRNGKey(3), mesh,
+                                capacity_factor=float("inf"))
+    notified = np.asarray(store.notified)[:64]
+    assert notified.mean() > 0.9, notified.mean()
 
 
 def test_sharded_announce_seq_edit_policy():

@@ -49,17 +49,38 @@ def test_searchsorted_matches_python(swarm):
         assert got_r[i] == bisect.bisect_right(vals, qi)
 
 
+def _unpack_tables(tables, k):
+    """Host-side decode of the augmented u16 layout → (idx, s16)."""
+    lo = tables[..., :k].astype(np.uint32)
+    hi = tables[..., k:2 * k].astype(np.uint32)
+    idx = (lo | (hi << 16)).astype(np.int64)
+    idx = np.where(idx == 0xFFFFFFFF, -1, idx).astype(np.int32)
+    return idx, tables[..., 2 * k:].astype(np.uint32)
+
+
 def test_bucket_members_share_exact_prefix(swarm):
     ids = swarm.ids
     tables = np.asarray(swarm.tables)
-    n, b_total, width = tables.shape
-    if width == 2 * CFG.bucket_k:     # augmented: [idx K | m0 K]
-        m0 = tables[..., CFG.bucket_k:].astype(np.uint32)
-        tables = tables[..., :CFG.bucket_k]
-        # the fused member-limb half must equal the members' limb 0
+    n = tables.shape[0]
+    b_total = CFG.n_buckets
+    # 2-D row-contiguous storage (lane-padded for aug) → [N, B, W] view
+    if tables.dtype == np.uint16:
+        tables = tables[:, :b_total * 3 * CFG.bucket_k]
+    tables = tables.reshape(n, b_total, -1)
+    width = tables.shape[-1]
+    if tables.dtype == np.uint16:   # augmented: [lo K | hi K | s16 K]
+        assert width == 3 * CFG.bucket_k
+        tables, s16 = _unpack_tables(tables, CFG.bucket_k)
+        # each member's stored window must equal bits [b, b+16) of its
+        # first id limb, MSB-aligned
         ids_np = np.asarray(ids)
         safe = np.clip(tables, 0, n - 1)
-        assert (m0 == ids_np[:, 0][safe].astype(np.uint32)).all()
+        m0 = ids_np[:, 0][safe].astype(np.uint64)
+        for b in range(b_total):
+            want = ((m0[:, b] << np.uint64(b)) & 0xFFFFFFFF) >> 16
+            got = s16[:, b]
+            live = tables[:, b] >= 0
+            assert (got[live] == want[live].astype(np.uint32)).all(), b
     k = tables.shape[-1]
     rng = np.random.default_rng(0)
     for _ in range(40):
@@ -121,6 +142,62 @@ def test_lookup_under_churn(swarm):
     recall = np.asarray(lookup_recall(dead, CFG, res, targets))
     # convergence degrades under 25% churn but must stay useful
     assert recall.mean() > 0.7, recall.mean()
+
+
+def test_window_d0_matches_exact_truncation(swarm):
+    """The aug-table response distances must equal the exact first-limb
+    XOR distance with bits below the 16-bit window zeroed — i.e. the
+    reconstruction (prefix from nid_d0 + stored window) is EXACT
+    through bit w+16 for every candidate, both bucket rows, all
+    depths."""
+    from opendht_tpu.models.swarm import _respond
+    from opendht_tpu.ops.xor_metric import prefix_len32
+
+    rng = np.random.default_rng(5)
+    l, a = 64, 4
+    targets = jnp.asarray(rng.integers(0, 2**32, (l, 5), dtype=np.uint32))
+    nid = jnp.asarray(rng.integers(0, CFG.n_nodes, (l, a), dtype=np.int32))
+    ids0 = np.asarray(swarm.ids)[:, 0].astype(np.uint64)
+    nid_d0 = jnp.asarray(
+        ids0[np.asarray(nid)].astype(np.uint32)) ^ targets[:, 0][:, None]
+    resp, resp_d0, _ = _respond(swarm, CFG, targets, nid, nid_d0)
+    resp = np.asarray(resp).reshape(l, a, 2, CFG.bucket_k)
+    resp_d0 = np.asarray(resp_d0).reshape(l, a, 2, CFG.bucket_k)
+    c0 = np.clip(np.asarray(prefix_len32(nid_d0)), 0, CFG.n_buckets - 2)
+    t0 = np.asarray(targets)[:, 0].astype(np.uint64)
+    for li in range(l):
+        for ai in range(a):
+            for row in range(2):
+                w = int(c0[li, ai]) + row
+                keep = 32 - min(32, w + 16)   # low bits zeroed
+                for kk in range(CFG.bucket_k):
+                    j = resp[li, ai, row, kk]
+                    if j < 0:
+                        continue
+                    exact = int(ids0[j] ^ t0[li]) & 0xFFFFFFFF
+                    want = (exact >> keep) << keep
+                    assert int(resp_d0[li, ai, row, kk]) == want, \
+                        (li, ai, row, kk, w)
+
+
+def test_sample_origins_uniform_over_survivors():
+    """Origins under heavy churn must be uniform over survivors — the
+    round-3 two-draw rejection concentrated kill_frac² of all lookups
+    on ONE node (at 90 % death: 81 %)."""
+    from opendht_tpu.models.swarm import _sample_origins
+
+    n, l = 4096, 20000
+    alive = np.asarray(
+        jax.random.uniform(jax.random.PRNGKey(0), (n,)) >= 0.9)
+    origins = np.asarray(_sample_origins(
+        jax.random.PRNGKey(1), jnp.asarray(alive), l))
+    assert alive[origins].all(), "origin sampled from a dead node"
+    survivors = np.nonzero(alive)[0]
+    counts = np.bincount(origins, minlength=n)[survivors]
+    mean = l / len(survivors)
+    # every survivor is reachable, none dominates
+    assert (counts > 0).mean() > 0.95
+    assert counts.max() < 3 * mean, (counts.max(), mean)
 
 
 def test_true_closest_matches_bruteforce(swarm):
