@@ -69,6 +69,13 @@ def main():
                          "hotshard mode: target skew (default 1.2)")
     ap.add_argument("--shards", type=int, default=8,
                     help="hotshard mode: logical owner shards")
+    ap.add_argument("--slots", type=int, default=16,
+                    help="putget/churn: store slots per node (drop to "
+                         "4-8 at 10M nodes — the [N,slots] store must "
+                         "share HBM with the ~10 GB routing table)")
+    ap.add_argument("--payload-words", type=int, default=0,
+                    help="putget: attach real 4*W-byte value payloads "
+                         "(verified on get); 0 = token-only store")
     ap.add_argument("--rounds", type=lambda s: max(1, int(s)), default=1,
                     help="churn mode: kill/republish cycles, min 1 "
                          "(the mult_time persistence scenario)")
@@ -201,19 +208,24 @@ def putget_main(args):
     from opendht_tpu.models.swarm import SwarmConfig, build_swarm
 
     cfg = SwarmConfig.for_nodes(args.nodes)
-    scfg = StoreConfig(slots=16, listen_slots=4,
-                       max_listeners=1 << 10)
+    scfg = StoreConfig(slots=args.slots, listen_slots=4,
+                       max_listeners=1 << 10,
+                       payload_words=args.payload_words)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
     jax.block_until_ready(swarm.tables)
     p = args.puts
     keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
     vals = jnp.arange(p, dtype=jnp.uint32) + 1
     seqs = jnp.ones((p,), jnp.uint32)
+    payloads = (jax.random.bits(jax.random.PRNGKey(8),
+                                (p, args.payload_words), jnp.uint32)
+                if args.payload_words else None)
 
     def roundtrip(seed):
         store = empty_store(cfg.n_nodes, scfg)
         store, rep = announce(swarm, cfg, store, scfg, keys, vals, seqs,
-                              0, jax.random.PRNGKey(seed))
+                              0, jax.random.PRNGKey(seed),
+                              payloads=payloads)
         res = get_values(swarm, cfg, store, scfg, keys,
                          jax.random.PRNGKey(seed + 1))
         return rep, res
@@ -245,12 +257,19 @@ def putget_main(args):
         "hit_rate": float(np.asarray(res.hit).mean()),
         "mean_replicas": float(np.asarray(rep.replicas).mean()),
         "median_hops": float(np.median(np.asarray(res.hops))),
-        # Device stores hold uint32 value tokens + abstract sizes, not
-        # payload bytes; the 64 KB cap / fragmentation live on the host
-        # path (net/network_engine.py) — see BASELINE.md fidelity note.
-        "sim_fidelity": "token-values",
+        # Token-only stores hold uint32 value tokens + abstract sizes;
+        # --payload-words attaches REAL bytes, verified below — see
+        # BASELINE.md fidelity note.
+        "sim_fidelity": ("payload-chunks" if args.payload_words
+                         else "token-values"),
         "platform": jax.devices()[0].platform,
     }
+    if args.payload_words:
+        hit = np.asarray(res.hit)
+        ok = (np.asarray(res.payload)[hit]
+              == np.asarray(payloads)[hit]).all()
+        out["payload_bytes"] = 4 * args.payload_words
+        out["payloads_intact"] = bool(ok)
     print(json.dumps(out))
 
 
@@ -270,7 +289,8 @@ def churn_main(args):
     from opendht_tpu.models.swarm import SwarmConfig, build_swarm, churn
 
     cfg = SwarmConfig.for_nodes(args.nodes)
-    scfg = StoreConfig(slots=16, listen_slots=4, max_listeners=1 << 10)
+    scfg = StoreConfig(slots=args.slots, listen_slots=4,
+                       max_listeners=1 << 10)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
     _ = np.asarray(swarm.tables[:1, :1])
     p = args.puts

@@ -87,12 +87,21 @@ class StoreConfig(NamedTuple):
     *size* cap in abstract units (0 = unlimited), the scaled analog of
     the reference's 64 MB ``max_store_size``; values also carry sizes,
     so full-node rejection is by bytes, not just slot count.
+
+    ``payload_words`` > 0 attaches a fixed-width REAL payload to every
+    stored value (``[N, S, W] uint32`` — 4·W bytes each): announces
+    carry the actual bytes, replicas store them, gets return the
+    freshest replica's bytes.  This is the device analogue of the
+    reference's value data (64 KB cap, value.h:73) at a fixed chunk
+    width; 0 (default) keeps the token-only store, flagged as
+    ``sim_fidelity: "token-values"`` in bench artifacts.
     """
     slots: int = 16
     listen_slots: int = 4
     ttl: int = 0
     max_listeners: int = 1 << 16
     budget: int = 0
+    payload_words: int = 0
 
 
 class SwarmStore(NamedTuple):
@@ -109,6 +118,7 @@ class SwarmStore(NamedTuple):
     notified: jax.Array  # [max_listeners] bool — listener got a push
     sizes: jax.Array     # [N,S] uint32   — stored value sizes
     ttls: jax.Array      # [N,S] uint32   — per-value ttl (0 = cfg.ttl)
+    payload: jax.Array   # [N,S,W] uint32 — value bytes (W = 0: tokens only)
 
 
 class AnnounceReport(NamedTuple):
@@ -123,6 +133,7 @@ class GetResult(NamedTuple):
     seq: jax.Array   # [P] uint32
     hops: jax.Array  # [P]
     done: jax.Array  # [P]
+    payload: jax.Array = None  # [P,W] uint32 — bytes (None/W=0: tokens)
 
 
 @partial(jax.jit, static_argnames=("n_nodes", "scfg"))
@@ -141,6 +152,7 @@ def empty_store(n_nodes: int, scfg: StoreConfig) -> SwarmStore:
         notified=jnp.zeros((scfg.max_listeners,), bool),
         sizes=jnp.zeros((n, s), jnp.uint32),
         ttls=jnp.zeros((n, s), jnp.uint32),
+        payload=jnp.zeros((n, s, scfg.payload_words), jnp.uint32),
     )
 
 
@@ -179,16 +191,19 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
                   req_val: jax.Array, req_seq: jax.Array,
                   req_put: jax.Array, now: jax.Array,
                   req_size: jax.Array | None = None,
-                  req_ttl: jax.Array | None = None
+                  req_ttl: jax.Array | None = None,
+                  put_payloads: jax.Array | None = None
                   ) -> Tuple[SwarmStore, jax.Array]:
     """Insert a flat batch of (node, key, val, seq) storage requests.
 
     ``req_node [M]`` (-1 = skip), ``req_key [M,5]``, ``req_val [M]``,
     ``req_seq [M]``, ``req_put [M]`` (originating put row);
     ``req_size``/``req_ttl`` optional ``[M]`` (default 1 / cfg
-    default).  Returns the new store and accepted-replica counts
-    scattered by ``req_put`` into a length-M vector (callers slice the
-    first P rows).
+    default).  ``put_payloads [Pmax, W]``: optional real value bytes,
+    indexed by ``req_put`` (per-PUT, not per-request, so the request
+    sort never carries W-wide columns).  Returns the new store and
+    accepted-replica counts scattered by ``req_put`` into a length-M
+    vector (callers slice the first P rows).
 
     Semantics per request, mirroring ``Dht::storageStore`` +
     ``secureType`` edit policy
@@ -245,11 +260,21 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     first = jnp.searchsorted(s_node_sk, s_node_sk, side="left")
 
     # --- edit policy (monotone seq; equal seq only re-announces the
-    # --- same value, ref securedht.cpp:105-115) and new-key candidacy
+    # --- same value — token AND bytes, ref securedht.cpp:105-115
+    # --- "if the data is exactly the same") and new-key candidacy
+    w = store.payload.shape[-1]
+    if w:
+        s_pl = (jnp.zeros((m, w), jnp.uint32) if put_payloads is None
+                else put_payloads[
+                    jnp.clip(s_put, 0, put_payloads.shape[0] - 1)])
     cur_seq = store.seqs[n_safe, mslot]
     cur_val = store.vals[n_safe, mslot]
+    same = s_val == cur_val
+    if w:
+        same = same & jnp.all(s_pl == store.payload[n_safe, mslot],
+                              axis=-1)
     upd = live & has_match & (
-        (s_seq > cur_seq) | ((s_seq == cur_seq) & (s_val == cur_val)))
+        (s_seq > cur_seq) | ((s_seq == cur_seq) & same))
     new = live & ~has_match
     if scfg.budget:
         # Byte budget (the reference's max_store_size rejection,
@@ -289,6 +314,14 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     created = _pad1(store.created).at[un, us].set(now)
     sizes = _pad1(store.sizes).at[un, us].set(s_size)
     ttls = _pad1(store.ttls).at[un, us].set(s_ttl)
+    # Payload written unconditionally when enabled (zeros for a
+    # payload-less announce): a slot's bytes must never outlive the
+    # value that owned them — a ring-wrapped new key would otherwise
+    # return the previous occupant's bytes on get.
+    if w:
+        payload = _pad1(store.payload).at[un, us].set(s_pl)
+    else:
+        payload = _pad1(store.payload)
 
     # --- new-key path: ring-slot allocation, ≤ slots per node per batch
     rank = _segment_rank(s_node_sk, new, first)
@@ -309,6 +342,10 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     created = created.at[nn, slot].set(now)[:-1]
     sizes = sizes.at[nn, slot].set(s_size)[:-1]
     ttls = ttls.at[nn, slot].set(s_ttl)[:-1]
+    if w:
+        payload = payload.at[nn, slot].set(s_pl)[:-1]
+    else:
+        payload = payload[:-1]
     used = _pad1(store.used).at[nn, slot].set(True)[:-1]
     n_new = jnp.zeros_like(store.cursor).at[jnp.where(accept_new, s_node, 0)
                                             ].add(accept_new.astype(jnp.uint32))
@@ -327,7 +364,8 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
 
     new_store = store._replace(keys=keys, vals=vals, seqs=seqs,
                                created=created, used=used, cursor=cursor,
-                               notified=notified, sizes=sizes, ttls=ttls)
+                               notified=notified, sizes=sizes, ttls=ttls,
+                               payload=payload)
     # Per-put replica counts.
     put_safe = jnp.clip(s_put, 0, None)
     replicas = jnp.zeros((m,), jnp.int32).at[put_safe].add(
@@ -352,7 +390,8 @@ def _announce_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                      scfg: StoreConfig, res_found: jax.Array,
                      keys: jax.Array, vals: jax.Array, seqs: jax.Array,
                      now: jax.Array, sizes: jax.Array | None = None,
-                     ttls: jax.Array | None = None
+                     ttls: jax.Array | None = None,
+                     payloads: jax.Array | None = None
                      ) -> Tuple[SwarmStore, jax.Array]:
     p, q = res_found.shape
     req_node = _mask_dead(swarm, cfg, res_found.reshape(-1))
@@ -364,7 +403,7 @@ def _announce_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     req_ttl = None if ttls is None else jnp.repeat(ttls, q, axis=0)
     store, rep_m = _store_insert(store, scfg, req_node, req_key, req_val,
                                  req_seq, req_put, now, req_size,
-                                 req_ttl)
+                                 req_ttl, payloads)
     return store, rep_m[:p]
 
 
@@ -372,16 +411,18 @@ def announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
              scfg: StoreConfig, keys: jax.Array, vals: jax.Array,
              seqs: jax.Array, now, rng: jax.Array,
              sizes: jax.Array | None = None,
-             ttls: jax.Array | None = None
+             ttls: jax.Array | None = None,
+             payloads: jax.Array | None = None
              ) -> Tuple[SwarmStore, AnnounceReport]:
     """Batched put: lookup each key, store at its quorum closest alive
     nodes.  ``keys [P,5]``, ``vals [P]``, ``seqs [P]``; optional
-    per-value ``sizes`` (budget accounting) and ``ttls`` (per-type
-    expiration), both ``[P]``."""
+    per-value ``sizes`` (budget accounting), ``ttls`` (per-type
+    expiration), both ``[P]``, and real value bytes ``payloads
+    [P, scfg.payload_words]``."""
     res = _announce_targets(swarm, cfg, keys, rng)
     store, replicas = _announce_insert(
         swarm, cfg, store, scfg, res.found, keys, vals, seqs,
-        jnp.uint32(now), sizes, ttls)
+        jnp.uint32(now), sizes, ttls, payloads)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
                                  done=res.done)
 
@@ -389,7 +430,7 @@ def announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
 @partial(jax.jit, static_argnames=("cfg",))
 def _get_probe(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                found: jax.Array, keys: jax.Array
-               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+               ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Probe the stores of each get's closest queried nodes
     (``onGetValues`` replies, collected by ``onGetValuesDone``,
     /root/reference/src/dht.cpp:3227-3297).  Freshest seq wins."""
@@ -403,7 +444,18 @@ def _get_probe(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     is_best = hit & (sseq == best_seq[:, None, None])
     val = jnp.max(jnp.where(is_best, store.vals[n_safe], 0), axis=(1, 2))
     any_hit = jnp.any(hit, axis=(1, 2))
-    return any_hit, val, best_seq
+    # Real bytes of ONE winning replica — picked by index, never an
+    # elementwise max across replicas: divergent same-(seq,val) replica
+    # payloads (possible via partial-quorum announces) would otherwise
+    # blend into bytes no replica ever held.
+    p = found.shape[0]
+    is_win = (is_best & (store.vals[n_safe] == val[:, None, None])
+              ).reshape(p, -1)                         # [P, Q*S]
+    widx = jnp.argmax(is_win, axis=1)
+    pls = store.payload[n_safe].reshape(p, is_win.shape[1], -1)
+    pl = jnp.take_along_axis(pls, widx[:, None, None], axis=1)[:, 0]
+    pl = jnp.where(any_hit[:, None], pl, 0)
+    return any_hit, val, best_seq, pl
 
 
 def get_values(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
@@ -413,15 +465,16 @@ def get_values(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     among the closest queried nodes.  ``keys [P,5]``."""
     res = lookup(swarm, cfg, keys, rng)
     p = keys.shape[0]
-    hits, vals, seqs = [], [], []
+    hits, vals, seqs, pls = [], [], [], []
     for lo in range(0, p, chunk):
         hi = min(lo + chunk, p)
-        h, v, s = _get_probe(swarm, cfg, store, res.found[lo:hi],
-                             keys[lo:hi])
-        hits.append(h), vals.append(v), seqs.append(s)
+        h, v, s, pl = _get_probe(swarm, cfg, store, res.found[lo:hi],
+                                 keys[lo:hi])
+        hits.append(h), vals.append(v), seqs.append(s), pls.append(pl)
     return GetResult(
         hit=jnp.concatenate(hits), val=jnp.concatenate(vals),
-        seq=jnp.concatenate(seqs), hops=res.hops, done=res.done)
+        seq=jnp.concatenate(seqs), hops=res.hops, done=res.done,
+        payload=jnp.concatenate(pls))
 
 
 @partial(jax.jit, static_argnames=("cfg", "scfg"))
@@ -510,11 +563,16 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     seqs = store.seqs[n_safe].reshape(-1)
     sizes = store.sizes[n_safe].reshape(-1)
     ttls = store.ttls[n_safe].reshape(-1)
+    # Explicit first dim: reshape(-1, 0) is ill-defined for the
+    # zero-width (token-only) payload array.
+    payloads = store.payload[n_safe].reshape(
+        node_idx.shape[0] * s, store.payload.shape[-1])
     okf = ok.reshape(-1)
     res = lookup(swarm, cfg, keys, rng)
     found = jnp.where(okf[:, None], res.found, -1)
     store, replicas = _announce_insert(swarm, cfg, store, scfg, found,
                                        keys, vals, seqs,
-                                       jnp.uint32(now), sizes, ttls)
+                                       jnp.uint32(now), sizes, ttls,
+                                       payloads)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
                                  done=res.done)

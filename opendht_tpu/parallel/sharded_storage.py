@@ -98,7 +98,7 @@ def _route_back(resp: jax.Array, owner: jax.Array, pos: jax.Array,
 def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
                    capacity_factor: float, alive,
                    store_local: SwarmStore, found, keys, vals, seqs,
-                   sizes, ttls, now):
+                   sizes, ttls, now, payloads=None):
     """Routed store-insert phase shared by announce and republish:
     ship each (replica-target, key, val, seq, size, ttl) request to the
     owning shard, apply it against the local store shard with the full
@@ -114,11 +114,16 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     owner = jnp.clip(safe // shard_n, 0, n_shards - 1).astype(jnp.int32)
     local_row = jnp.where(ok, safe - owner * shard_n, -1)
 
+    w = store_local.payload.shape[-1]
     rep = lambda a: jnp.repeat(a, quorum, axis=0)
-    payload = jnp.concatenate(
-        [local_row[:, None], _u2i(rep(keys)),
-         _u2i(rep(vals))[:, None], _u2i(rep(seqs))[:, None],
-         _u2i(rep(sizes))[:, None], _u2i(rep(ttls))[:, None]], axis=1)
+    cols = [local_row[:, None], _u2i(rep(keys)),
+            _u2i(rep(vals))[:, None], _u2i(rep(seqs))[:, None],
+            _u2i(rep(sizes))[:, None], _u2i(rep(ttls))[:, None]]
+    if w and payloads is not None:
+        # Real value bytes ride the same routed request — the wire
+        # form of the reference actually carrying the data.
+        cols.append(_u2i(rep(payloads)))
+    payload = jnp.concatenate(cols, axis=1)
 
     cap = _cap_for(q, n_shards, capacity_factor)
     rbuf, pos, sent = _route_out(payload, owner, ok, n_shards, cap)
@@ -130,12 +135,14 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     r_size = _i2u(rbuf[..., 3 + N_LIMBS]).reshape(-1)
     r_ttl = _i2u(rbuf[..., 4 + N_LIMBS]).reshape(-1)
     m = r_node.shape[0]
+    r_pl = (_i2u(rbuf[..., 5 + N_LIMBS:]).reshape(m, -1)
+            if w and payloads is not None else None)
     # req_put = flat request index → _store_insert's replica vector
     # becomes a per-request accept bit we can route back.
     store_local, acc = _store_insert(
         store_local, scfg, r_node, r_key, r_val, r_seq,
         jnp.arange(m, dtype=jnp.int32), now,
-        jnp.maximum(r_size, 1), r_ttl)
+        jnp.maximum(r_size, 1), r_ttl, r_pl)
 
     back = _route_back(acc.reshape(n_shards, cap, 1), owner, pos, sent,
                        cap)
@@ -153,14 +160,14 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
 def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
                    capacity_factor: float, ids, tables_local,
                    alive, store_local: SwarmStore, keys, vals, seqs,
-                   sizes, ttls, key, now):
+                   sizes, ttls, payloads, key, now):
     """Per-shard announce: routed lookup, then routed store inserts."""
     found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
                                       ids, tables_local, alive, keys,
                                       key)
     store_local, replicas = _insert_routed(
         cfg, scfg, n_shards, capacity_factor, alive, store_local,
-        found, keys, vals, seqs, sizes, ttls, now)
+        found, keys, vals, seqs, sizes, ttls, now, payloads)
     return store_local, replicas, hops, done
 
 
@@ -197,22 +204,40 @@ def _get_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
         & jnp.all(sk == r_key[:, None, :], axis=-1)      # [M,S]
     seq = jnp.where(hit, store_local.seqs[n_safe], 0)
     best = jnp.max(seq, axis=1)
-    val = jnp.max(jnp.where(hit & (seq == best[:, None]),
-                            store_local.vals[n_safe], 0), axis=1)
+    is_b = hit & (seq == best[:, None])
+    val = jnp.max(jnp.where(is_b, store_local.vals[n_safe], 0), axis=1)
     anyhit = jnp.any(hit, axis=1)
+    w = store_local.payload.shape[-1]
+    # Bytes of ONE winning replica ride back with the (hit, val, seq)
+    # triple — picked by index, never an elementwise max (divergent
+    # same-(seq,val) payloads must not blend; see _get_probe).
+    is_w = is_b & (store_local.vals[n_safe] == val[:, None])  # [M,S]
+    widx = jnp.argmax(is_w, axis=1)
+    pl = jnp.take_along_axis(store_local.payload[n_safe],
+                             widx[:, None, None], axis=1)[:, 0]
+    pl = jnp.where(anyhit[:, None], pl, 0)
 
-    resp = jnp.stack([anyhit.astype(jnp.int32), _u2i(val), _u2i(best)],
-                     axis=-1).reshape(n_shards, cap, 3)
-    back = _route_back(resp, owner, pos, sent, cap)      # [Q,3]
+    resp = jnp.concatenate(
+        [jnp.stack([anyhit.astype(jnp.int32), _u2i(val), _u2i(best)],
+                   axis=-1), _u2i(pl)],
+        axis=-1).reshape(n_shards, cap, 3 + w)
+    back = _route_back(resp, owner, pos, sent, cap)      # [Q,3+W]
     h = (back[:, 0] > 0).reshape(ll, quorum)
     v = _i2u(jnp.where(sent, back[:, 1], 0)).reshape(ll, quorum)
     s = _i2u(jnp.where(sent, back[:, 2], 0)).reshape(ll, quorum)
+    q_pl = _i2u(jnp.where(sent[:, None], back[:, 3:], 0)
+                ).reshape(ll, quorum, w)
 
     s = jnp.where(h, s, 0)
     best_seq = jnp.max(s, axis=1)
-    best_val = jnp.max(jnp.where(h & (s == best_seq[:, None]), v, 0),
-                       axis=1)
-    return jnp.any(h, axis=1), best_val, best_seq, hops, done
+    win = h & (s == best_seq[:, None])
+    best_val = jnp.max(jnp.where(win, v, 0), axis=1)
+    # Single-replica pick across the quorum too (no word blending).
+    qidx = jnp.argmax(win & (v == best_val[:, None]), axis=1)
+    out_pl = jnp.take_along_axis(q_pl, qidx[:, None, None],
+                                 axis=1)[:, 0]
+    out_pl = jnp.where(jnp.any(h, axis=1)[:, None], out_pl, 0)
+    return jnp.any(h, axis=1), best_val, best_seq, out_pl, hops, done
 
 
 def _store_specs(mesh: Mesh) -> SwarmStore:
@@ -223,7 +248,8 @@ def _store_specs(mesh: Mesh) -> SwarmStore:
         keys=P(AXIS, None, None), vals=P(AXIS, None), seqs=P(AXIS, None),
         created=P(AXIS, None), used=P(AXIS, None), cursor=shd,
         lkeys=P(AXIS, None, None), lids=P(AXIS, None), lcursor=shd,
-        notified=P(), sizes=P(AXIS, None), ttls=P(AXIS, None))
+        notified=P(), sizes=P(AXIS, None), ttls=P(AXIS, None),
+        payload=P(AXIS, None, None))
 
 
 def shard_store(store: SwarmStore, mesh: Mesh) -> SwarmStore:
@@ -242,7 +268,8 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                      key: jax.Array, mesh: Mesh,
                      capacity_factor: float = 4.0,
                      sizes: jax.Array | None = None,
-                     ttls: jax.Array | None = None
+                     ttls: jax.Array | None = None,
+                     payloads: jax.Array | None = None
                      ) -> Tuple[SwarmStore, AnnounceReport]:
     """Batched put over the sharded swarm + store.
 
@@ -257,18 +284,21 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
         sizes = jnp.ones((p,), jnp.uint32)
     if ttls is None:
         ttls = jnp.zeros((p,), jnp.uint32)
+    if payloads is None:
+        payloads = jnp.zeros((p, scfg.payload_words), jnp.uint32)
     specs = _store_specs(mesh)
     fn = jax.shard_map(
         partial(_announce_body, cfg, scfg, n_shards, capacity_factor),
         mesh=mesh,
         in_specs=(P(), P(AXIS, None), P(), specs, P(AXIS, None),
-                  P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+                  P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS, None),
+                  P(), P()),
         out_specs=(specs, P(AXIS), P(AXIS), P(AXIS)),
         check_vma=False,
     )
     store, replicas, hops, done = fn(swarm.ids, swarm.tables,
                                      swarm.alive, store, keys, vals,
-                                     seqs, sizes, ttls, key,
+                                     seqs, sizes, ttls, payloads, key,
                                      jnp.uint32(now))
     return store, AnnounceReport(replicas=replicas, hops=hops, done=done)
 
@@ -286,12 +316,14 @@ def sharded_get(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
         mesh=mesh,
         in_specs=(P(), P(AXIS, None), P(), specs, P(AXIS, None),
                   P()),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P(AXIS),
+                   P(AXIS)),
         check_vma=False,
     )
-    hit, val, seq, hops, done = fn(swarm.ids, swarm.tables, swarm.alive,
-                                   store, keys, key)
-    return GetResult(hit=hit, val=val, seq=seq, hops=hops, done=done)
+    hit, val, seq, pl, hops, done = fn(swarm.ids, swarm.tables,
+                                       swarm.alive, store, keys, key)
+    return GetResult(hit=hit, val=val, seq=seq, hops=hops, done=done,
+                     payload=pl)
 
 
 def sharded_empty_store(n_nodes: int, scfg: StoreConfig,
@@ -327,9 +359,11 @@ def _republish_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
                                       key)
     # Dead/empty source slots announce to no one.
     found = jnp.where(okf[:, None], found, -1)
+    payloads = store_local.payload.reshape(
+        shard_n * scfg.slots, store_local.payload.shape[-1])
     store_local, replicas = _insert_routed(
         cfg, scfg, n_shards, capacity_factor, alive, store_local,
-        found, keys, vals, seqs, sizes, ttls, now)
+        found, keys, vals, seqs, sizes, ttls, now, payloads)
     return store_local, replicas, hops, done
 
 

@@ -424,3 +424,80 @@ def test_byte_budget_huge_size_cannot_wrap(small_swarm):
         jnp.zeros((1,), jnp.uint32))
     assert int(_np.asarray(acc).sum()) == 0
     assert not bool(_np.asarray(store.used[0]).any())
+
+
+def test_payload_chunks_roundtrip(small_swarm):
+    """payload_words > 0: announce carries real bytes, get returns the
+    freshest replica's bytes — the device analogue of the reference's
+    value data (value.h:73) at fixed chunk width."""
+    swarm, cfg = small_swarm
+    scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024,
+                       payload_words=4)
+    store = empty_store(cfg.n_nodes, scfg)
+    p = 64
+    keys = _rand_keys(40, p)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+    payloads = jax.random.bits(jax.random.PRNGKey(41), (p, 4),
+                               jnp.uint32)
+    store, rep = announce(swarm, cfg, store, scfg, keys, vals, seqs, 0,
+                          jax.random.PRNGKey(42), payloads=payloads)
+    assert float(jnp.mean(rep.replicas)) > 3
+    res = get_values(swarm, cfg, store, scfg, keys,
+                     jax.random.PRNGKey(43))
+    assert float(jnp.mean(res.hit)) > 0.95
+    hit = np.asarray(res.hit)
+    got, want = np.asarray(res.payload), np.asarray(payloads)
+    assert (got[hit] == want[hit]).all(), "payload bytes corrupted"
+
+
+def test_payload_survives_republish(small_swarm):
+    """Bytes must survive churn + maintenance: republished values carry
+    their payloads to the new replicas."""
+    swarm, cfg = small_swarm
+    scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024,
+                       payload_words=2)
+    store = empty_store(cfg.n_nodes, scfg)
+    p = 48
+    keys = _rand_keys(50, p)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+    payloads = jax.random.bits(jax.random.PRNGKey(51), (p, 2),
+                               jnp.uint32)
+    store, _ = announce(swarm, cfg, store, scfg, keys, vals, seqs, 0,
+                        jax.random.PRNGKey(52), payloads=payloads)
+    dead = churn(swarm, jax.random.PRNGKey(53), 0.5, cfg)
+    all_idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    store, _ = republish_from(dead, cfg, store, scfg, all_idx, 1,
+                              jax.random.PRNGKey(54))
+    res = get_values(dead, cfg, store, scfg, keys,
+                     jax.random.PRNGKey(55))
+    hit = np.asarray(res.hit)
+    assert hit.mean() > 0.9
+    got, want = np.asarray(res.payload), np.asarray(payloads)
+    assert (got[hit] == want[hit]).all(), "payload lost in republish"
+
+
+def test_payload_equal_seq_different_bytes_rejected(small_swarm):
+    """Equal-seq re-announce is only a refresh when the DATA is
+    identical — token and bytes (ref securedht.cpp:105-115 "if the
+    data is exactly the same").  Different bytes at the same seq must
+    not overwrite."""
+    swarm, cfg = small_swarm
+    scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024,
+                       payload_words=2)
+    store = empty_store(cfg.n_nodes, scfg)
+    key = _rand_keys(60, 1)
+    val = jnp.asarray([7], jnp.uint32)
+    seq = jnp.asarray([5], jnp.uint32)
+    pl_x = jnp.asarray([[1, 2]], jnp.uint32)
+    pl_y = jnp.asarray([[9, 9]], jnp.uint32)
+    store, _ = announce(swarm, cfg, store, scfg, key, val, seq, 0,
+                        jax.random.PRNGKey(61), payloads=pl_x)
+    store, rep = announce(swarm, cfg, store, scfg, key, val, seq, 1,
+                          jax.random.PRNGKey(62), payloads=pl_y)
+    res = get_values(swarm, cfg, store, scfg, key,
+                     jax.random.PRNGKey(63))
+    assert bool(res.hit[0])
+    assert np.asarray(res.payload)[0].tolist() == [1, 2], \
+        "equal-seq announce with different bytes overwrote"
