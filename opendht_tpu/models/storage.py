@@ -444,18 +444,26 @@ def _get_probe(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     is_best = hit & (sseq == best_seq[:, None, None])
     val = jnp.max(jnp.where(is_best, store.vals[n_safe], 0), axis=(1, 2))
     any_hit = jnp.any(hit, axis=(1, 2))
-    # Real bytes of ONE winning replica — picked by index, never an
-    # elementwise max across replicas: divergent same-(seq,val) replica
-    # payloads (possible via partial-quorum announces) would otherwise
-    # blend into bytes no replica ever held.
     p = found.shape[0]
     is_win = (is_best & (store.vals[n_safe] == val[:, None, None])
               ).reshape(p, -1)                         # [P, Q*S]
-    widx = jnp.argmax(is_win, axis=1)
-    pls = store.payload[n_safe].reshape(p, is_win.shape[1], -1)
-    pl = jnp.take_along_axis(pls, widx[:, None, None], axis=1)[:, 0]
-    pl = jnp.where(any_hit[:, None], pl, 0)
+    pl = _pick_payload(is_win,
+                       store.payload[n_safe].reshape(p, is_win.shape[1],
+                                                     -1), any_hit)
     return any_hit, val, best_seq, pl
+
+
+def _pick_payload(win: jax.Array, pls: jax.Array,
+                  any_hit: jax.Array) -> jax.Array:
+    """ONE winning replica's payload, picked by index — never an
+    elementwise max across replicas: divergent same-(seq,val) replica
+    payloads (possible via partial-quorum announces) would otherwise
+    blend into bytes no replica ever held.  ``win [M,K]`` winner mask,
+    ``pls [M,K,W]`` candidate payloads, ``any_hit [M]``; zeros on miss.
+    """
+    widx = jnp.argmax(win, axis=1)
+    pl = jnp.take_along_axis(pls, widx[:, None, None], axis=1)[:, 0]
+    return jnp.where(any_hit[:, None], pl, 0)
 
 
 def get_values(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,

@@ -39,6 +39,7 @@ from ..models.storage import (
     GetResult,
     StoreConfig,
     SwarmStore,
+    _pick_payload,
     _segment_rank,
     _store_insert,
     empty_store,
@@ -209,13 +210,9 @@ def _get_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     anyhit = jnp.any(hit, axis=1)
     w = store_local.payload.shape[-1]
     # Bytes of ONE winning replica ride back with the (hit, val, seq)
-    # triple — picked by index, never an elementwise max (divergent
-    # same-(seq,val) payloads must not blend; see _get_probe).
+    # triple (no-blend single pick — see _pick_payload).
     is_w = is_b & (store_local.vals[n_safe] == val[:, None])  # [M,S]
-    widx = jnp.argmax(is_w, axis=1)
-    pl = jnp.take_along_axis(store_local.payload[n_safe],
-                             widx[:, None, None], axis=1)[:, 0]
-    pl = jnp.where(anyhit[:, None], pl, 0)
+    pl = _pick_payload(is_w, store_local.payload[n_safe], anyhit)
 
     resp = jnp.concatenate(
         [jnp.stack([anyhit.astype(jnp.int32), _u2i(val), _u2i(best)],
@@ -233,10 +230,8 @@ def _get_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     win = h & (s == best_seq[:, None])
     best_val = jnp.max(jnp.where(win, v, 0), axis=1)
     # Single-replica pick across the quorum too (no word blending).
-    qidx = jnp.argmax(win & (v == best_val[:, None]), axis=1)
-    out_pl = jnp.take_along_axis(q_pl, qidx[:, None, None],
-                                 axis=1)[:, 0]
-    out_pl = jnp.where(jnp.any(h, axis=1)[:, None], out_pl, 0)
+    out_pl = _pick_payload(win & (v == best_val[:, None]), q_pl,
+                           jnp.any(h, axis=1))
     return jnp.any(h, axis=1), best_val, best_seq, out_pl, hops, done
 
 

@@ -492,10 +492,15 @@ def test_payload_equal_seq_different_bytes_rejected(small_swarm):
     seq = jnp.asarray([5], jnp.uint32)
     pl_x = jnp.asarray([[1, 2]], jnp.uint32)
     pl_y = jnp.asarray([[9, 9]], jnp.uint32)
+    # SAME rng for both announces → identical lookups → identical
+    # quorum sets, so the second announce meets the first's replicas
+    # everywhere and the edit policy decides at every node (a disjoint
+    # node would store pl_y as a new key — the divergence case
+    # _pick_payload guards against, but not what's under test here).
     store, _ = announce(swarm, cfg, store, scfg, key, val, seq, 0,
                         jax.random.PRNGKey(61), payloads=pl_x)
     store, rep = announce(swarm, cfg, store, scfg, key, val, seq, 1,
-                          jax.random.PRNGKey(62), payloads=pl_y)
+                          jax.random.PRNGKey(61), payloads=pl_y)
     res = get_values(swarm, cfg, store, scfg, key,
                      jax.random.PRNGKey(63))
     assert bool(res.hit[0])
