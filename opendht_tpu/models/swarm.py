@@ -670,7 +670,7 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
     # Typical convergence depth ≈ log2(N)/log2(2K) solicitation rounds
-    # plus tail; start with one burst of that size, then probe in 2s.
+    # plus tail; start with one burst of that size.
     burst = min(cfg.max_steps,
                 max(6, int(math.log2(max(2, cfg.n_nodes)) / 4) + 5))
     rounds = 0
@@ -682,6 +682,10 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         if bool(jnp.all(st.done)):
             break
         burst = 2
+    # (A tail-compaction variant — argsort the active minority into a
+    # quarter-width sub-batch after the burst — measured SLOWER at 10M:
+    # 334.8k vs 357.6k lookups/s; the sort/gather/scatter and the extra
+    # pending-count readback cost more than 2-3 cheaper tail rounds.)
     return LookupResult(found=_finalize(swarm.ids, st, cfg),
                         hops=st.hops, done=st.done)
 
