@@ -39,6 +39,23 @@ class LookupResultConcat:
         self.done = jnp.concatenate([r.done for r in results])
 
 
+def even_chunk_size(total: int, target: int, multiple: int = 1) -> int:
+    """Chunk size near ``target`` that divides ``total`` evenly (and is
+    a multiple of ``multiple`` — mesh divisibility for sharded runs).
+    A ragged last chunk would compile every program twice; prefer a few
+    more even chunks.  Falls back to a ragged split only when no even
+    divisor exists within 2× of the target."""
+    n0 = max(1, -(-total // target))
+    for n in range(n0, 2 * n0 + 1):
+        if total % n == 0 and (total // n) % multiple == 0:
+            return total // n
+    # Ragged fallback: keep every chunk multiple-aligned, so the tail
+    # (total - k·chunk) is too whenever total itself is — sharded
+    # callers must still pass a mesh-divisible total.
+    c = -(-total // n0)
+    return -(-c // multiple) * multiple
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=None,
@@ -59,7 +76,7 @@ def main():
     ap.add_argument("--recall-sample", type=int, default=512)
     ap.add_argument("--mode",
                     choices=("lookups", "putget", "churn", "crawl",
-                             "sharded", "hotshard"),
+                             "sharded", "hotshard", "repub"),
                     default="lookups")
     ap.add_argument("--kill-frac", type=float, default=0.5,
                     help="fraction of nodes killed in --mode churn")
@@ -69,10 +86,11 @@ def main():
                          "hotshard mode: target skew (default 1.2)")
     ap.add_argument("--shards", type=int, default=8,
                     help="hotshard mode: logical owner shards")
-    ap.add_argument("--slots", type=int, default=16,
-                    help="putget/churn: store slots per node (drop to "
-                         "4-8 at 10M nodes — the [N,slots] store must "
-                         "share HBM with the ~10 GB routing table)")
+    ap.add_argument("--slots", type=int, default=0,
+                    help="putget/churn: store slots per node (0 = "
+                         "auto: 16, scaled down at big N so the "
+                         "[N,slots] store fits HBM beside the routing "
+                         "table)")
     ap.add_argument("--payload-words", type=int, default=0,
                     help="putget: attach real 4*W-byte value payloads "
                          "(verified on get); 0 = token-only store")
@@ -81,11 +99,17 @@ def main():
                          "(the mult_time persistence scenario)")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture an XLA profiler trace of one timed run")
+    ap.add_argument("--decompose", action="store_true",
+                    help="sharded mode: measure the overhead ladder "
+                         "(local bursts → shard_map/while_loop "
+                         "structure → routing machinery → capacity "
+                         "rule) on a 1-device mesh")
     args = ap.parse_args()
 
     if args.nodes is None:
         args.nodes = {"churn": 100_000, "sharded": 1_000_000,
-                      "hotshard": 1_000_000}.get(args.mode, 10_000_000)
+                      "hotshard": 1_000_000,
+                      "repub": 131_072}.get(args.mode, 10_000_000)
     if args.mode == "putget":
         return putget_main(args)
     if args.mode == "churn":
@@ -96,6 +120,8 @@ def main():
         return sharded_main(args)
     if args.mode == "hotshard":
         return hotshard_main(args)
+    if args.mode == "repub":
+        return repub_main(args)
 
     from opendht_tpu.models.swarm import (
         SwarmConfig, build_swarm, lookup, true_closest,
@@ -113,14 +139,8 @@ def main():
         # Big-table swarms: the per-step response/merge temps scale
         # with L, and next to a ~10 GB table a full 1M-lookup batch
         # OOMs; ~500k chunks keep peak HBM in budget (measured best:
-        # 359.7k lookups/s vs 277k at 250k chunks).  Split EVENLY — a
-        # ragged last chunk would compile every program twice.
-        n0 = -(-args.lookups // 524_288)
-        n_chunks = next((n for n in range(n0, 2 * n0 + 1)
-                         if args.lookups % n == 0), n0)
-        # No even divisor near the target → accept a ragged last chunk
-        # (one extra compile) rather than walking to a tiny divisor.
-        args.lookup_batch = -(-args.lookups // n_chunks)
+        # 359.7k lookups/s vs 277k at 250k chunks).
+        args.lookup_batch = even_chunk_size(args.lookups, 524_288)
     lb = args.lookup_batch or args.lookups
     chunks = [targets[lo:lo + lb] for lo in range(0, args.lookups, lb)]
 
@@ -195,6 +215,43 @@ def main():
     print(json.dumps(out))
 
 
+def auto_slots(args, cfg):
+    """Store slots per node for --slots 0 (auto).
+
+    16 (the calibrated default) while HBM allows; at big N the
+    ``[N, slots]`` store must share the chip with the routing table
+    and the lookup transients, so slots scale down from what
+    ``memory_stats()`` reports instead of relying on manual ``--slots``
+    guidance at 10M nodes.
+    """
+    if args.slots:
+        return args.slots
+    from opendht_tpu.models.swarm import _pad128, device_hbm_bytes
+
+    # The bench always runs on a live device — initialize the backend
+    # now so device_hbm_bytes() reads the real memory_stats() instead
+    # of its conservative uninitialized-backend fallback.
+    n_shards = max(1, len(jax.devices()))
+    if not getattr(args, "mode", "") in ("sharded", "repub"):
+        n_shards = 1          # local engine: whole state on one chip
+    # Per-DEVICE shares: tables and the store shard over the node axis.
+    n = cfg.n_nodes // n_shards
+    if cfg.aug_tables:
+        table = n * _pad128(cfg.n_buckets * 3 * cfg.bucket_k) * 2
+    else:
+        table = n * cfg.n_buckets * cfg.bucket_k * 4
+    w = getattr(args, "payload_words", 0) or 0
+    # keys 20 + five u32 scalars + used flag (+ payload words) per slot
+    per_slot = n * (44 + 4 * w)
+    # Slot-independent store state: listener tables (4 listen slots:
+    # lkeys 80 B + lids 16 B) + cursors — ~1 GB at 10M nodes, NOT
+    # negligible against the transient reserve.
+    fixed = n * (4 * 24 + 8)
+    free = device_hbm_bytes() - table - 20 * cfg.n_nodes - fixed \
+        - 3_000_000_000
+    return int(max(2, min(16, free // max(per_slot, 1))))
+
+
 def putget_main(args):
     """Full DHT round-trip: announce P values, then get them all.
 
@@ -208,7 +265,7 @@ def putget_main(args):
     from opendht_tpu.models.swarm import SwarmConfig, build_swarm
 
     cfg = SwarmConfig.for_nodes(args.nodes)
-    scfg = StoreConfig(slots=args.slots, listen_slots=4,
+    scfg = StoreConfig(slots=auto_slots(args, cfg), listen_slots=4,
                        max_listeners=1 << 10,
                        payload_words=args.payload_words)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
@@ -253,6 +310,7 @@ def putget_main(args):
         "vs_baseline": round(p / dt / REFERENCE_LOOKUPS_PER_SEC, 2),
         "n_nodes": args.nodes,
         "n_puts": p,
+        "slots": scfg.slots,
         "wall_s": round(dt, 4),
         "hit_rate": float(np.asarray(res.hit).mean()),
         "mean_replicas": float(np.asarray(rep.replicas).mean()),
@@ -289,7 +347,7 @@ def churn_main(args):
     from opendht_tpu.models.swarm import SwarmConfig, build_swarm, churn
 
     cfg = SwarmConfig.for_nodes(args.nodes)
-    scfg = StoreConfig(slots=args.slots, listen_slots=4,
+    scfg = StoreConfig(slots=auto_slots(args, cfg), listen_slots=4,
                        max_listeners=1 << 10)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
     _ = np.asarray(swarm.tables[:1, :1])
@@ -353,6 +411,7 @@ def churn_main(args):
         "vs_baseline": round(survival / (7 / 8), 3),
         "n_nodes": cfg.n_nodes,
         "n_puts": p,
+        "slots": scfg.slots,
         "kill_frac": args.kill_frac,
         "zipf": args.zipf,
         "rounds": args.rounds,
@@ -479,6 +538,14 @@ def sharded_main(args):
     _ = np.asarray(swarm.tables[:1, :1])
     l = args.lookups
     targets = jax.random.bits(jax.random.PRNGKey(1), (l, 5), jnp.uint32)
+    # Big-table swarms: per-round respond temps scale with the lookup
+    # chunk (the [Q, row_w] fetched-rows buffer alone is ~4 GB at
+    # L=1M, 10M nodes) — chunk like the local lookups mode, keeping
+    # every chunk divisible by the mesh (shard_map's P(AXIS) axis).
+    if not args.lookup_batch and args.nodes >= 4_000_000:
+        args.lookup_batch = even_chunk_size(l, 262_144, multiple=n_dev)
+    lb = args.lookup_batch or l
+    t_chunks = [targets[lo:lo + lb] for lo in range(0, l, lb)]
 
     def timed(fn, sync):
         sync(fn(2))  # warmup/compile — synced, or its execution tail
@@ -490,18 +557,50 @@ def sharded_main(args):
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
+    def chunked(fn_one):
+        def run(seed):
+            rs = [fn_one(c, seed + i) for i, c in enumerate(t_chunks)]
+            # Sync every chunk (cheap scalar) so none is left in flight.
+            for r in rs:
+                int(np.asarray(jnp.sum(r.found[:, 0])))
+            return LookupResultConcat(rs)
+        return run
+
     sync_l = lambda r: int(np.asarray(jnp.sum(r.found[:, 0])))
-    t_local = timed(
-        lambda s: lookup(swarm, cfg, targets, jax.random.PRNGKey(s)),
+    t_local = timed(chunked(
+        lambda c, s: lookup(swarm, cfg, c, jax.random.PRNGKey(s))),
         sync_l)
-    t_shard = timed(
-        lambda s: sharded_lookup(swarm, cfg, targets,
-                                 jax.random.PRNGKey(s), mesh,
-                                 capacity_factor=2.0), sync_l)
+    t_shard = timed(chunked(
+        lambda c, s: sharded_lookup(swarm, cfg, c,
+                                    jax.random.PRNGKey(s), mesh,
+                                    capacity_factor=2.0)), sync_l)
+    ladder = {}
+    if args.decompose and n_dev == 1:
+        # Overhead ladder on the 1-device mesh: each rung adds one
+        # piece of the sharded machinery (BASELINE.md round-5 ask).
+        t_struct = timed(chunked(
+            lambda c, s: sharded_lookup(swarm, cfg, c,
+                                        jax.random.PRNGKey(s), mesh,
+                                        local_respond=True)), sync_l)
+        t_inf = timed(chunked(
+            lambda c, s: sharded_lookup(swarm, cfg, c,
+                                        jax.random.PRNGKey(s), mesh,
+                                        capacity_factor=float("inf"))),
+            sync_l)
+        ladder = {
+            "local_burst_s": round(t_local, 4),
+            "shardmap_whileloop_s": round(t_struct, 4),
+            "routed_uncapped_s": round(t_inf, 4),
+            "routed_cf2_s": round(t_shard, 4),
+            "structure_overhead_frac": round(t_struct / t_local - 1, 4),
+            "routing_overhead_frac": round(t_inf / t_struct - 1, 4),
+            "capacity_overhead_frac": round(t_shard / t_inf - 1, 4),
+        }
 
     # Storage round-trip: local vs routed announce+get.
     p = args.puts
-    scfg = StoreConfig(slots=16, listen_slots=4, max_listeners=1 << 10)
+    scfg = StoreConfig(slots=auto_slots(args, cfg), listen_slots=4,
+                       max_listeners=1 << 10)
     keys = jax.random.bits(jax.random.PRNGKey(4), (p, 5), jnp.uint32)
     vals = jnp.arange(p, dtype=jnp.uint32) + 1
     seqs = jnp.ones((p,), jnp.uint32)
@@ -526,8 +625,9 @@ def sharded_main(args):
     t_pg_local = timed(local_putget, sync_g)
     t_pg_shard = timed(shard_putget, sync_g)
 
-    res = sharded_lookup(swarm, cfg, targets, jax.random.PRNGKey(7),
-                         mesh, capacity_factor=2.0)
+    res = chunked(
+        lambda c, s: sharded_lookup(swarm, cfg, c, jax.random.PRNGKey(s),
+                                    mesh, capacity_factor=2.0))(7)
     out = {
         "metric": "swarm_sharded_lookups_per_sec",
         "value": round(l / t_shard, 1),
@@ -545,6 +645,130 @@ def sharded_main(args):
         "done_frac": float(np.asarray(res.done).mean()),
         "median_hops": float(np.median(np.asarray(res.hops))),
         "capacity_factor": 2.0,
+        "lookup_batch": lb,
+        "platform": jax.devices()[0].platform,
+    }
+    if ladder:
+        out["decomposition"] = ladder
+    print(json.dumps(out))
+
+
+def repub_main(args):
+    """Announce-with-probe vs full-payload republish on the routed
+    sharded path: wire traffic at equal survival.
+
+    The reference's two-phase announce probes ``SELECT id,seq`` and
+    ships the full value only where missing/stale, refreshing
+    otherwise (/root/reference/src/dht.cpp:1237-1339, :1299-1307) —
+    the biggest win on maintenance, where most replicas already hold
+    the value.  This mode measures exactly that: churn → one republish
+    sweep (full vs probed), then a steady-state sweep (no churn —
+    every replica fresh), comparing the storage-exchange all_to_all
+    words (static accounting, ``storage_wire_words``; the lookup
+    phase is identical in both variants) and the post-sweep survival.
+    """
+    from opendht_tpu.models.storage import StoreConfig
+    from opendht_tpu.models.swarm import SwarmConfig, build_swarm, churn
+    from opendht_tpu.parallel import make_mesh
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_get,
+        sharded_republish, storage_wire_words,
+    )
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev)
+    cfg = SwarmConfig.for_nodes(args.nodes)
+    w = args.payload_words or 64     # 256-byte values: maintenance is
+    #                                  payload-dominated, as upstream
+    # Slot count bounds the maintenance batch (every node × every slot
+    # becomes a lookup) — small fixed default, not the HBM-driven auto.
+    scfg = StoreConfig(slots=args.slots or 4, listen_slots=4,
+                       max_listeners=1 << 10, payload_words=w)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    _ = np.asarray(swarm.tables[:1, :1])
+    p = args.puts
+    keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+    payloads = jax.random.bits(jax.random.PRNGKey(8), (p, w), jnp.uint32)
+    cf = 4.0
+    kf = args.kill_frac
+    # Full-value phase provisioning under probe: sized to the expected
+    # churn-displaced fraction (+ headroom), not the full announce load.
+    fcf_churn = min(cf, cf * kf + 0.8)
+    fcf_steady = 0.5
+
+    def run_cycles(probe, seed):
+        store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+        store, _ = sharded_announce(swarm, cfg, store, scfg, keys, vals,
+                                    seqs, 0, jax.random.PRNGKey(seed),
+                                    mesh, capacity_factor=cf,
+                                    payloads=payloads)
+        dead = churn(swarm, jax.random.PRNGKey(100), kf, cfg)
+        fcf = fcf_churn if probe else None
+        t0 = time.perf_counter()
+        store, rep = sharded_republish(dead, cfg, store, scfg, 1,
+                                       jax.random.PRNGKey(seed + 2),
+                                       mesh, capacity_factor=cf,
+                                       probe=probe,
+                                       full_capacity_factor=fcf)
+        _ = int(np.asarray(jnp.sum(rep.replicas[:8])))
+        churn_s = time.perf_counter() - t0
+        # Steady-state sweep: nothing changed since the last one, so a
+        # probed sweep is almost pure refresh traffic.
+        fcf2 = fcf_steady if probe else None
+        store, rep2 = sharded_republish(dead, cfg, store, scfg, 2,
+                                        jax.random.PRNGKey(seed + 3),
+                                        mesh, capacity_factor=cf,
+                                        probe=probe,
+                                        full_capacity_factor=fcf2)
+        _ = int(np.asarray(jnp.sum(rep2.replicas[:8])))
+        res = sharded_get(dead, cfg, store, scfg, keys,
+                          jax.random.PRNGKey(seed + 4), mesh,
+                          capacity_factor=cf)
+        surv = float(np.asarray(res.hit).mean())
+        hitm = np.asarray(res.hit)
+        intact = bool((np.asarray(res.payload)[hitm]
+                       == np.asarray(payloads)[hitm]).all())
+        p_shard = (cfg.n_nodes // n_dev) * scfg.slots
+        words_churn = storage_wire_words(cfg, scfg, p_shard, n_dev, cf,
+                                         probe=probe,
+                                         full_capacity_factor=fcf)
+        words_steady = storage_wire_words(cfg, scfg, p_shard, n_dev, cf,
+                                          probe=probe,
+                                          full_capacity_factor=fcf2)
+        return surv, intact, words_churn, words_steady, churn_s
+
+    s_full, ok_full, w_full, ws_full, t_full = run_cycles(False, 20)
+    s_probe, ok_probe, w_probe, ws_probe, t_probe = run_cycles(True, 30)
+
+    out = {
+        "metric": "repub_probe_wire_words_reduction",
+        "value": round(1 - w_probe / w_full, 4),
+        "unit": "fraction",
+        "vs_baseline": round(s_probe / max(s_full, 1e-9), 4),
+        "baseline_note": "vs_baseline = survival ratio probed/full "
+                         "(1.0 = equal survival at the reduced wire "
+                         "budget)",
+        "n_nodes": cfg.n_nodes,
+        "n_puts": p,
+        "slots": scfg.slots,
+        "payload_bytes": 4 * w,
+        "kill_frac": kf,
+        "capacity_factor": cf,
+        "full_capacity_factor_churn": fcf_churn,
+        "full_capacity_factor_steady": fcf_steady,
+        "survival_full": round(s_full, 4),
+        "survival_probe": round(s_probe, 4),
+        "payloads_intact": bool(ok_full and ok_probe),
+        "wire_words_churn_full": w_full,
+        "wire_words_churn_probe": w_probe,
+        "wire_words_steady_full": ws_full,
+        "wire_words_steady_probe": ws_probe,
+        "steady_reduction": round(1 - ws_probe / ws_full, 4),
+        "republish_wall_s_full": round(t_full, 3),
+        "republish_wall_s_probe": round(t_probe, 3),
+        "sim_fidelity": "payload-chunks",
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(out))

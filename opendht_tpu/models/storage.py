@@ -119,6 +119,16 @@ class SwarmStore(NamedTuple):
     sizes: jax.Array     # [N,S] uint32   — stored value sizes
     ttls: jax.Array      # [N,S] uint32   — per-value ttl (0 = cfg.ttl)
     payload: jax.Array   # [N,S,W] uint32 — value bytes (W = 0: tokens only)
+    # Listener DELIVERY slots: what ``tellListener`` pushed — the
+    # changed value itself, not just a "something changed" bit
+    # (/root/reference/src/dht.cpp:2186-2225,
+    # src/network_engine.cpp:161-173).  Freshest-seq announce wins.
+    # ``nseqs`` holds delivered_seq + 1 so 0 means "nothing delivered"
+    # even for a seq-0 value (keeps the cross-shard winner merge
+    # unambiguous on first delivery).
+    nseqs: jax.Array     # [max_listeners] uint32 — delivered seq + 1
+    nvals: jax.Array     # [max_listeners] uint32 — delivered value token
+    npayload: jax.Array  # [max_listeners,W] uint32 — delivered bytes
 
 
 class AnnounceReport(NamedTuple):
@@ -153,6 +163,10 @@ def empty_store(n_nodes: int, scfg: StoreConfig) -> SwarmStore:
         sizes=jnp.zeros((n, s), jnp.uint32),
         ttls=jnp.zeros((n, s), jnp.uint32),
         payload=jnp.zeros((n, s, scfg.payload_words), jnp.uint32),
+        nseqs=jnp.zeros((scfg.max_listeners,), jnp.uint32),
+        nvals=jnp.zeros((scfg.max_listeners,), jnp.uint32),
+        npayload=jnp.zeros((scfg.max_listeners, scfg.payload_words),
+                           jnp.uint32),
     )
 
 
@@ -362,10 +376,45 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
         jnp.where(lmatch, lid_safe, 0).reshape(-1)
     ].max(lmatch.reshape(-1))
 
+    # --- listener VALUE delivery: the push carries the changed value
+    # itself (ref tellListener sends the value list,
+    # src/network_engine.cpp:161-173), freshest seq winning.  No-blend
+    # winner pick without a sort: (1) scatter-max each listener's seq
+    # (vs the already-delivered one), (2) scatter-max the REQUEST ROW
+    # among rows achieving that seq, (3) one gather copies exactly that
+    # row's (val, seq, payload) — duplicate-seq ties resolve to one
+    # deterministic row, so val and bytes can never mix across rows.
+    lidf = jnp.where(lmatch, lid_safe, 0).reshape(-1)     # [M*LS]
+    matchf = lmatch.reshape(-1)
+    rowf = jnp.repeat(jnp.arange(m, dtype=jnp.int32), lmatch.shape[1])
+    # seq+1, saturating: seq 0xFFFFFFFF must not wrap to the "nothing
+    # delivered" sentinel 0 (it would overwrite nvals while nseqs says
+    # no delivery).  The last two seq values share one slot encoding —
+    # harmless, monotonicity preserved.
+    seq1f = jnp.minimum(jnp.repeat(s_seq, lmatch.shape[1]),
+                        jnp.uint32(0xFFFFFFFE)) + 1
+    nseqs = store.nseqs.at[lidf].max(jnp.where(matchf, seq1f, 0))
+    win1 = matchf & (seq1f == nseqs[lidf])
+    rmax = jnp.full_like(store.nseqs, -1, jnp.int32).at[lidf].max(
+        jnp.where(win1, rowf, -1))
+    deliver = rmax >= 0                                   # [max_listeners]
+    r_safe = jnp.clip(rmax, 0, m - 1)
+    nvals = jnp.where(deliver, s_val[r_safe], store.nvals)
+    nseqs = jnp.where(
+        deliver,
+        jnp.minimum(s_seq[r_safe], jnp.uint32(0xFFFFFFFE)) + 1,
+        store.nseqs)
+    if w:
+        npayload = jnp.where(deliver[:, None], s_pl[r_safe],
+                             store.npayload)
+    else:
+        npayload = store.npayload
+
     new_store = store._replace(keys=keys, vals=vals, seqs=seqs,
                                created=created, used=used, cursor=cursor,
                                notified=notified, sizes=sizes, ttls=ttls,
-                               payload=payload)
+                               payload=payload, nseqs=nseqs, nvals=nvals,
+                               npayload=npayload)
     # Per-put replica counts.
     put_safe = jnp.clip(s_put, 0, None)
     replicas = jnp.zeros((m,), jnp.int32).at[put_safe].add(

@@ -53,6 +53,59 @@ from ..ops.xor_metric import (
 UINT32_MAX = 0xFFFFFFFF
 
 
+def _pad128(x: int) -> int:
+    """Round up to the TPU lane multiple (128 elements)."""
+    return -(-x // 128) * 128
+
+
+def device_hbm_bytes() -> int:
+    """Accelerator memory limit of the default device, in bytes.
+
+    Queried from ``memory_stats()`` so the augmented-table cutoff and
+    store sizing track the actual chip instead of hardcoding one HBM
+    size (a 16 GB literal OOMs a v5e-1 with less usable HBM and
+    needlessly disables the fast path on bigger parts).  Backends
+    without stats (CPU, some drivers) fall back to the measured v5e-1
+    figure this repo's thresholds were calibrated on.
+    """
+    global _HBM_BYTES
+    if _HBM_BYTES is None:
+        # Never INITIALIZE a backend from here: config construction
+        # must stay pure (initializing would freeze the platform and
+        # break the dryrun's switch-to-virtual-CPU-first invariant,
+        # __graft_entry__._force_virtual_cpu_devices — the round-1
+        # failure mode).  Query only an already-live backend; return
+        # the fallback uncached otherwise so a later, initialized call
+        # can still pick up the real figure.
+        try:
+            from jax._src import xla_bridge as _xb
+            live = bool(getattr(_xb, "_backends", None))
+        except Exception:
+            live = False   # fail CLOSED: never initialize from here
+        if not live:
+            return 16_000_000_000
+        limit = 0
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                limit = int(stats.get("bytes_limit", 0))
+        except Exception:
+            limit = 0
+        _HBM_BYTES = limit if limit > 0 else 16_000_000_000
+    return _HBM_BYTES
+
+
+_HBM_BYTES: int | None = None
+
+
+def _aug_table_budget() -> int:
+    """HBM available to the augmented table: the device limit minus
+    measured headroom for ids + ~500k-lookup per-step transients
+    (~4.5 GB at the 10M-node north-star config, BASELINE.md round 4 —
+    16 GB chip → the calibrated 11.5 GB cutoff)."""
+    return device_hbm_bytes() - 4_500_000_000
+
+
 class SwarmConfig(NamedTuple):
     """Static swarm geometry (Python ints — part of the jit cache key).
 
@@ -91,11 +144,14 @@ class SwarmConfig(NamedTuple):
         # chip holds.
         b = min(26, max(4, int(math.ceil(math.log2(max(16, n_nodes)))) - 3))
         k = kw.get("bucket_k", 8)
-        # Augmented while the table fits comfortably on one 16 GB chip
-        # (~11.5 GB leaves headroom for ids + 1M-lookup transients);
-        # the 10M-node north star (10.1 GB at B=21) stays on.
-        kw.setdefault("aug_tables", n_nodes * b * 3 * k * 2
-                      <= 11_500_000_000)
+        # Augmented while the table fits the device's HBM with lookup
+        # headroom.  Sized with the PADDED row width — rows pad to a
+        # 128-lane multiple, up to ~27% over the raw B*3K estimate —
+        # so a table near the cutoff can't silently exceed budget.
+        # The 10M-node north star (10.2 GB padded at B=21) stays on
+        # for a 16 GB chip.
+        kw.setdefault("aug_tables", n_nodes * _pad128(b * 3 * k) * 2
+                      <= _aug_table_budget())
         return cls(n_nodes=n_nodes, n_buckets=b, **kw)
 
 
@@ -129,7 +185,8 @@ class Swarm(NamedTuple):
       fetched via span gathers — functional fallback, slow at scale.
     """
     ids: jax.Array     # [N,5] uint32, lexicographically sorted
-    tables: jax.Array  # [N,B,K or 2K] int32 — see class docstring
+    tables: jax.Array  # [N, pad128(B*3K)] u16 (augmented) or
+    #                    [N, B*K] i32 (plain) — see class docstring
     alive: jax.Array   # [N] bool
 
 
@@ -296,7 +353,7 @@ def build_swarm(key: jax.Array, cfg: SwarmConfig) -> Swarm:
     if cfg.aug_tables:
         # Row padded to a 128-lane multiple: lane-aligned rows are what
         # keeps the whole-row gather on the fast path (Swarm docstring).
-        row_w = -(-(b_total * 3 * k) // 128) * 128
+        row_w = _pad128(b_total * 3 * k)
         tables = jnp.full((n, row_w), 0xFFFF, jnp.uint16)
     else:
         tables = jnp.full((n, b_total * k), -1, jnp.int32)
@@ -384,9 +441,33 @@ def _respond(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
 def _select_pair_window(rows: jax.Array, c0: jax.Array, w3: int,
                         b_total: int) -> jax.Array:
     """Extract the adjacent bucket-pair window ``rows[q,
-    c0[q]·w3 : c0[q]·w3 + 2·w3]`` with a B-way static-slice select
-    chain (XLA fuses it into one pass over the fetched rows).
-    ``c0`` must be pre-clipped to ``[0, b_total-2]``."""
+    c0[q]·w3 : c0[q]·w3 + 2·w3]`` with static-slice selects (XLA fuses
+    them into one pass over the fetched rows).  ``c0`` must be
+    pre-clipped to ``[0, b_total-2]``.
+
+    Two-level select when the padded row allows it: a coarse select
+    among ``ceil(B/g)`` group windows of width ``(g+1)·w3`` (g=4),
+    then a fine g-way select inside the group — ~30 % fewer
+    where-elements than the linear B-way chain, which profiled at
+    ~19 ms/step at the 10M-node config (the second-largest step cost
+    after the row gather itself).
+    """
+    g = 4
+    n_pos = b_total - 1                   # c0 ∈ [0, b_total-2]
+    hi_max = (n_pos - 1) // g
+    gw = (g + 1) * w3
+    if hi_max >= 1 and hi_max * g * w3 + gw <= rows.shape[1]:
+        hi = c0 // g
+        lo = c0 - hi * g
+        grp = rows[:, 0:gw]
+        for h in range(1, hi_max + 1):
+            s = h * g * w3
+            grp = jnp.where((hi == h)[:, None], rows[:, s:s + gw], grp)
+        win = grp[:, 0:2 * w3]
+        for b in range(1, g):
+            win = jnp.where((lo == b)[:, None],
+                            grp[:, b * w3:b * w3 + 2 * w3], win)
+        return win
     win = rows[:, 0:2 * w3]
     for b in range(1, b_total - 1):
         win = jnp.where((c0 == b)[:, None],
@@ -627,8 +708,15 @@ def _sample_origins(key: jax.Array, alive: jax.Array,
                            jnp.int32)
     # First index whose cumulative alive-count exceeds u = the
     # (u+1)-th alive node; clip only guards the all-dead degenerate.
-    return jnp.clip(jnp.searchsorted(cum, u, side="right"),
-                    0, n - 1).astype(jnp.int32)
+    # All-alive fast path (every non-churn benchmark): cum is the
+    # identity+1, so the inverse-CDF is u itself — lax.cond skips the
+    # L·log N binary-search gathers at runtime (measured ~80 ms per
+    # 500k draws over 10M nodes, 3 % of the whole north-star run).
+    return jax.lax.cond(
+        total == n,
+        lambda: u,
+        lambda: jnp.clip(jnp.searchsorted(cum, u, side="right"),
+                         0, n - 1).astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -669,10 +757,15 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     # Origins are drawn from *alive* nodes: the issuing node exists.
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
-    # Typical convergence depth ≈ log2(N)/log2(2K) solicitation rounds
-    # plus tail; start with one burst of that size.
+    # First burst sized to the MEASURED convergence depth (pending-by-
+    # round on v5e-1, 500k uniform lookups: 100k nodes → 7 rounds, 1M →
+    # 8, 10M → 9 ≈ ceil(log2 N / 2.56)); every extra dispatched round
+    # costs a full-batch step (~97 ms at the north-star config) whether
+    # or not anything is pending, while an undershoot costs one ~100 ms
+    # scalar readback plus a 2-round top-up — so aim exactly and let
+    # the done-check loop absorb seed variance.
     burst = min(cfg.max_steps,
-                max(6, int(math.log2(max(2, cfg.n_nodes)) / 4) + 5))
+                max(6, math.ceil(math.log2(max(2, cfg.n_nodes)) / 2.56)))
     rounds = 0
     while rounds < cfg.max_steps:
         n = min(burst, cfg.max_steps - rounds)
@@ -704,16 +797,26 @@ def _finalize(ids: jax.Array, st: LookupState,
     """Exact-order result extraction, once per lookup.
 
     The hot loop orders the shortlist by the 32-bit surrogate; here the
-    S=14 survivors are re-sorted by the full 160-bit distance (one
-    small gather + one [L,S] sort), so the reported top-``quorum`` is
-    exactly XOR-ordered regardless of surrogate ties.
+    shortlist HEAD is re-sorted by the full 160-bit distance (one small
+    gather + one [L,F] sort), so the reported top-``quorum`` is exactly
+    XOR-ordered regardless of surrogate ties.  Only ``F = quorum + 2``
+    head entries join the exact sort: a true top-``quorum`` member can
+    sit below surrogate rank F only after ≥2 surrogate-order inversions
+    against it, and a d0 inversion between distinct candidates needs a
+    ≥16-significant-bit tie (≤2⁻¹⁷ per pair — see
+    ``merge_shortlists_d0``); the two-slot margin covers the ~per-mille
+    single-inversion cases while cutting the dominant per-row id gather
+    from S=14 to 10 rows per lookup (measured ~90 ms per 1M lookups at
+    10M nodes).
     """
     n = ids.shape[0]
-    cand = ids[jnp.clip(st.idx, 0, n - 1)]                  # [L,S,5]
+    f = min(cfg.search_width, cfg.quorum + 2)
+    idx, queried = st.idx[:, :f], st.queried[:, :f]
+    cand = ids[jnp.clip(idx, 0, n - 1)]                     # [L,F,5]
     d = jnp.bitwise_xor(cand, st.targets[:, None, :])
-    d = jnp.where((st.idx < 0)[..., None], jnp.uint32(UINT32_MAX), d)
+    d = jnp.where((idx < 0)[..., None], jnp.uint32(UINT32_MAX), d)
     keys = tuple(d[..., i] for i in range(N_LIMBS))
-    out = jax.lax.sort(keys + (st.idx, st.queried), dimension=1,
+    out = jax.lax.sort(keys + (idx, queried), dimension=1,
                        num_keys=N_LIMBS)
     f_idx, f_q = out[N_LIMBS], out[N_LIMBS + 1]
     return jnp.where(f_q[:, :cfg.quorum], f_idx[:, :cfg.quorum], -1)

@@ -37,6 +37,7 @@ from ..models.swarm import (
     _finalize,
     _gather_span,
     _local_respond,
+    _respond,
     _sample_origins,
     _select_alpha,
     _select_pair_window,
@@ -199,10 +200,18 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
 
 def _sharded_body(cfg: SwarmConfig, n_shards: int,
                   capacity_factor: float, ids, tables_local,
-                  alive, targets, key):
+                  alive, targets, key, local_respond: bool = False):
     """Runs per-device under shard_map: full lookup loop with routed
     responses.  Collective-synchronised while-loop (every shard decides
-    from the global not-done count)."""
+    from the global not-done count).
+
+    ``local_respond=True`` (measurement aid, valid only on a 1-device
+    mesh where ``tables_local`` is the whole table) answers
+    solicitations with the local engine's gathers inside the SAME
+    while_loop/shard_map structure — isolating loop-structure overhead
+    from the routing machinery in the sharded-overhead decomposition
+    (BASELINE.md).
+    """
     ll = targets.shape[0]
     me = jax.lax.axis_index(AXIS)
     key = jax.random.fold_in(key, me)
@@ -210,17 +219,26 @@ def _sharded_body(cfg: SwarmConfig, n_shards: int,
     from ..models.swarm import _sample_origins
     origins = _sample_origins(key, alive, ll)
 
-    def respond(tg, nid, nid_d0):
-        return _route_respond(tables_local, ids, alive, tg, nid,
-                              nid_d0, cfg, n_shards, capacity_factor)
+    if local_respond:
+        assert n_shards == 1, "local_respond is a 1-device measurement aid"
+        sw = Swarm(ids=ids, tables=tables_local, alive=alive)
 
-    def respond_init(tg, nid, nid_d0):
-        # The init seed is never re-sent: a capacity drop here would
-        # leave the lookup with an empty shortlist → instant
-        # exhaustion-done with nothing found.  It is also a one-off
-        # [D, Ll, 3] exchange (α=1), so run it uncapped.
-        return _route_respond(tables_local, ids, alive, tg, nid,
-                              nid_d0, cfg, n_shards, float("inf"))
+        def respond(tg, nid, nid_d0):
+            return _respond(sw, cfg, tg, nid, nid_d0)
+
+        respond_init = respond
+    else:
+        def respond(tg, nid, nid_d0):
+            return _route_respond(tables_local, ids, alive, tg, nid,
+                                  nid_d0, cfg, n_shards, capacity_factor)
+
+        def respond_init(tg, nid, nid_d0):
+            # The init seed is never re-sent: a capacity drop here would
+            # leave the lookup with an empty shortlist → instant
+            # exhaustion-done with nothing found.  It is also a one-off
+            # [D, Ll, 3] exchange (α=1), so run it uncapped.
+            return _route_respond(tables_local, ids, alive, tg, nid,
+                                  nid_d0, cfg, n_shards, float("inf"))
 
     # Init: origin's own table answers first (hop 0).  The lock-step
     # round logic is the single shared implementation from
@@ -240,21 +258,25 @@ def _sharded_body(cfg: SwarmConfig, n_shards: int,
     return _finalize(ids, st, cfg), st.hops, st.done
 
 
-@partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor"))
+@partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor",
+                                   "local_respond"))
 def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                    key: jax.Array, mesh: Mesh,
-                   capacity_factor: float = 2.0) -> LookupResult:
+                   capacity_factor: float = 2.0,
+                   local_respond: bool = False) -> LookupResult:
     """Full lookup batch with routing tables sharded over ``mesh``.
 
     ``swarm.tables`` is sharded on the node axis; ``ids`` and ``alive``
     replicated; ``targets`` sharded on the lookup axis.  N and L must
     divide the mesh size.  ``capacity_factor`` sizes the per-shard
     all_to_all buckets relative to the expected uniform load; queries
-    past capacity retry next round.
+    past capacity retry next round.  ``local_respond`` is the 1-device
+    decomposition aid (see :func:`_sharded_body`).
     """
     n_shards = mesh.shape[AXIS]
     fn = jax.shard_map(
-        partial(_sharded_body, cfg, n_shards, capacity_factor),
+        partial(_sharded_body, cfg, n_shards, capacity_factor,
+                local_respond=local_respond),
         mesh=mesh,
         in_specs=(P(), P(AXIS, None), P(), P(AXIS, None), P()),
         out_specs=(P(AXIS, None), P(AXIS), P(AXIS)),

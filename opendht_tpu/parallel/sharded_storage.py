@@ -39,6 +39,7 @@ from ..models.storage import (
     GetResult,
     StoreConfig,
     SwarmStore,
+    _pad1,
     _pick_payload,
     _segment_rank,
     _store_insert,
@@ -96,15 +97,70 @@ def _route_back(resp: jax.Array, owner: jax.Array, pos: jax.Array,
     return jnp.where(sent[:, None], mine, -1)
 
 
+def _probe_refresh(store_local: SwarmStore, r_node, r_key, r_seq,
+                   r_val, now):
+    """Owner-side announce probe + refresh (one exchange).
+
+    The reference's two-phase announce probes ``SELECT id,seq`` at each
+    synced replica and ships the full value only where it is missing or
+    stale, sending a cheap ``refresh`` (TTL reset) otherwise
+    (/root/reference/src/dht.cpp:1237-1339, refresh :1299-1307).  In
+    the lock-step engine probe and refresh collapse into one routed
+    exchange: the owner classifies each (key, seq, val) probe against
+    its store shard and refreshes matching replicas in place.
+
+    Returns ``(status [M], store_local)`` with status 0 = missing or
+    stale (send the full value), 1 = fresh same-value replica
+    (refreshed — ``created`` reset to ``now``), 2 = replica fresher or
+    equal-seq conflicting (skip: a full announce would be rejected by
+    the edit policy anyway).
+    """
+    rows = store_local.keys.shape[0]
+    n_safe = jnp.clip(r_node, 0, rows - 1)
+    valid = r_node >= 0
+    sk = store_local.keys[n_safe]                        # [M,S,5]
+    km = store_local.used[n_safe] \
+        & jnp.all(sk == r_key[:, None, :], axis=-1)      # [M,S]
+    has = jnp.any(km, axis=-1)
+    mslot = jnp.argmax(km, axis=-1).astype(jnp.int32)
+    cur_seq = store_local.seqs[n_safe, mslot]
+    cur_val = store_local.vals[n_safe, mslot]
+    fresh_same = valid & has & (cur_seq == r_seq) & (cur_val == r_val)
+    need_full = valid & (~has | (cur_seq < r_seq))
+    status = jnp.where(fresh_same, 1,
+                       jnp.where(need_full, 0, 2))
+    status = jnp.where(valid, status, -1)
+    # Refresh: reset the matching slot's age (duplicate probes of the
+    # same slot all write the same ``now`` — scatter-max is safe).
+    un = jnp.where(fresh_same, n_safe, rows)
+    created = _pad1(store_local.created).at[un, mslot].max(
+        jnp.uint32(now))[:-1]
+    return status, store_local._replace(created=created)
+
+
 def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
                    capacity_factor: float, alive,
                    store_local: SwarmStore, found, keys, vals, seqs,
-                   sizes, ttls, now, payloads=None):
+                   sizes, ttls, now, payloads=None, probe=False,
+                   full_capacity_factor=None):
     """Routed store-insert phase shared by announce and republish:
     ship each (replica-target, key, val, seq, size, ttl) request to the
     owning shard, apply it against the local store shard with the full
     edit-policy/budget semantics of ``_store_insert``, and route the
-    accept bits back.  Returns ``(store_local, replicas [ll])``."""
+    accept bits back.
+
+    ``probe=True`` enables the reference's two-phase announce (see
+    :func:`_probe_refresh`): a 9-word probe/refresh exchange first,
+    then the full-value exchange ONLY for replicas that reported
+    missing/stale, in buckets sized by ``full_capacity_factor`` (a
+    maintenance sweep expects most replicas to refresh, so the full
+    phase can be provisioned far below the probe phase; needy requests
+    past its capacity retry next sweep).  Returns
+    ``(store_local, replicas [ll])``.  The exchange's wire cost is
+    fully static — capacity buckets ship full-size regardless of fill
+    — so the traffic accounting lives in :func:`storage_wire_words`,
+    not on the device.
+    """
     ll, quorum = found.shape
     shard_n = cfg.n_nodes // n_shards
     q = ll * quorum
@@ -117,6 +173,28 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
 
     w = store_local.payload.shape[-1]
     rep = lambda a: jnp.repeat(a, quorum, axis=0)
+    refreshed = jnp.zeros((q,), bool)
+    if probe:
+        pcols = jnp.concatenate(
+            [local_row[:, None], _u2i(rep(keys)),
+             _u2i(rep(seqs))[:, None], _u2i(rep(vals))[:, None]],
+            axis=1)                                      # [Q, 8]
+        cap1 = _cap_for(q, n_shards, capacity_factor)
+        rbuf, pos1, sent1 = _route_out(pcols, owner, ok, n_shards, cap1)
+        p_node = rbuf[..., 0].reshape(-1)
+        p_key = _i2u(rbuf[..., 1:1 + N_LIMBS]).reshape(-1, N_LIMBS)
+        p_seq = _i2u(rbuf[..., 1 + N_LIMBS]).reshape(-1)
+        p_val = _i2u(rbuf[..., 2 + N_LIMBS]).reshape(-1)
+        status, store_local = _probe_refresh(store_local, p_node, p_key,
+                                             p_seq, p_val, now)
+        back = _route_back(status.reshape(n_shards, cap1, 1), owner,
+                           pos1, sent1, cap1)
+        st = back[:, 0]
+        refreshed = sent1 & (st == 1)
+        ok = sent1 & (st == 0)      # only missing/stale go to phase 2
+        if full_capacity_factor is None:
+            full_capacity_factor = capacity_factor
+
     cols = [local_row[:, None], _u2i(rep(keys)),
             _u2i(rep(vals))[:, None], _u2i(rep(seqs))[:, None],
             _u2i(rep(sizes))[:, None], _u2i(rep(ttls))[:, None]]
@@ -126,7 +204,8 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
         cols.append(_u2i(rep(payloads)))
     payload = jnp.concatenate(cols, axis=1)
 
-    cap = _cap_for(q, n_shards, capacity_factor)
+    cap = _cap_for(q, n_shards,
+                   full_capacity_factor if probe else capacity_factor)
     rbuf, pos, sent = _route_out(payload, owner, ok, n_shards, cap)
 
     r_node = rbuf[..., 0].reshape(-1)
@@ -148,18 +227,69 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     back = _route_back(acc.reshape(n_shards, cap, 1), owner, pos, sent,
                        cap)
     acc_mine = jnp.clip(back[:, 0], 0, 1).reshape(ll, quorum)
-    replicas = jnp.sum(acc_mine, axis=1, dtype=jnp.int32)
+    # A refreshed replica counts as holding the value (the reference's
+    # refresh ack completes the announce for that node, dht.cpp:1299).
+    replicas = jnp.sum(acc_mine + refreshed.reshape(ll, quorum),
+                       axis=1, dtype=jnp.int32)
 
-    # Listener-notification bits are a global table; merge the shards'
-    # local contributions.
-    notified = jax.lax.pmax(
-        store_local.notified.astype(jnp.int32), AXIS).astype(bool)
-    store_local = store_local._replace(notified=notified)
+    store_local = _merge_listener_state(store_local)
     return store_local, replicas
 
 
+def storage_wire_words(cfg: SwarmConfig, scfg: StoreConfig,
+                       p_per_shard: int, n_shards: int,
+                       capacity_factor: float, probe: bool = False,
+                       full_capacity_factor: float | None = None
+                       ) -> int:
+    """Per-shard all_to_all payload words of one storage-insert
+    exchange (:func:`_insert_routed`) — request buckets plus the
+    1-word-per-slot response ride-back.
+
+    Static by construction: the collectives ship their full capacity
+    buckets regardless of how many rows are real, so this is exact
+    accounting, not an estimate.  With ``probe`` the full-value phase
+    shrinks to ``full_capacity_factor`` while a 9-word probe phase is
+    added — the reference's probe-then-put traffic shape
+    (/root/reference/src/dht.cpp:1237-1339), where re-announcing a
+    value most replicas already hold costs probes, not payloads.
+    """
+    q = p_per_shard * cfg.quorum
+    w_full = 10 + scfg.payload_words + 1   # row+key5+val+seq+size+ttl+W, +ack
+    if not probe:
+        return _cap_for(q, n_shards, capacity_factor) * n_shards * w_full
+    fcf = (capacity_factor if full_capacity_factor is None
+           else full_capacity_factor)
+    return (_cap_for(q, n_shards, capacity_factor) * n_shards * (8 + 1)
+            + _cap_for(q, n_shards, fcf) * n_shards * w_full)
+
+
+def _merge_listener_state(store_local: SwarmStore) -> SwarmStore:
+    """Merge the shards' listener tables (global, replicated leaves).
+
+    Notified bits OR together; delivery slots merge freshest-seq-wins
+    with a single-winner shard pick — among the shards holding the
+    mesh-max ``nseqs`` (slots store delivered_seq+1, so a first
+    delivery always beats every stale replica), the highest-ranked one
+    contributes val AND bytes, so cross-shard blending is impossible
+    (same no-blend rule as :func:`_pick_payload`)."""
+    notified = jax.lax.pmax(
+        store_local.notified.astype(jnp.int32), AXIS).astype(bool)
+    gseq = jax.lax.pmax(store_local.nseqs, AXIS)
+    me = jax.lax.axis_index(AXIS).astype(jnp.int32)
+    is_win = store_local.nseqs == gseq
+    win_r = jax.lax.pmax(jnp.where(is_win, me, -1), AXIS)
+    mine = is_win & (me == win_r)
+    nvals = jax.lax.pmax(
+        jnp.where(mine, store_local.nvals, 0), AXIS)
+    npayload = jax.lax.pmax(
+        jnp.where(mine[:, None], store_local.npayload, 0), AXIS)
+    return store_local._replace(notified=notified, nseqs=gseq,
+                                nvals=nvals, npayload=npayload)
+
+
 def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
-                   capacity_factor: float, ids, tables_local,
+                   capacity_factor: float, probe: bool,
+                   full_capacity_factor, ids, tables_local,
                    alive, store_local: SwarmStore, keys, vals, seqs,
                    sizes, ttls, payloads, key, now):
     """Per-shard announce: routed lookup, then routed store inserts."""
@@ -168,7 +298,8 @@ def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
                                       key)
     store_local, replicas = _insert_routed(
         cfg, scfg, n_shards, capacity_factor, alive, store_local,
-        found, keys, vals, seqs, sizes, ttls, now, payloads)
+        found, keys, vals, seqs, sizes, ttls, now, payloads,
+        probe=probe, full_capacity_factor=full_capacity_factor)
     return store_local, replicas, hops, done
 
 
@@ -244,7 +375,8 @@ def _store_specs(mesh: Mesh) -> SwarmStore:
         created=P(AXIS, None), used=P(AXIS, None), cursor=shd,
         lkeys=P(AXIS, None, None), lids=P(AXIS, None), lcursor=shd,
         notified=P(), sizes=P(AXIS, None), ttls=P(AXIS, None),
-        payload=P(AXIS, None, None))
+        payload=P(AXIS, None, None), nseqs=P(), nvals=P(),
+        npayload=P(None, None))
 
 
 def shard_store(store: SwarmStore, mesh: Mesh) -> SwarmStore:
@@ -256,7 +388,8 @@ def shard_store(store: SwarmStore, mesh: Mesh) -> SwarmStore:
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "scfg", "mesh", "capacity_factor"))
+         static_argnames=("cfg", "scfg", "mesh", "capacity_factor",
+                          "probe", "full_capacity_factor"))
 def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                      scfg: StoreConfig, keys: jax.Array,
                      vals: jax.Array, seqs: jax.Array, now,
@@ -264,14 +397,19 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                      capacity_factor: float = 4.0,
                      sizes: jax.Array | None = None,
                      ttls: jax.Array | None = None,
-                     payloads: jax.Array | None = None
+                     payloads: jax.Array | None = None,
+                     probe: bool = False,
+                     full_capacity_factor: float | None = None
                      ) -> Tuple[SwarmStore, AnnounceReport]:
     """Batched put over the sharded swarm + store.
 
     ``keys [P,5]`` / ``vals [P]`` / ``seqs [P]`` (and optional
     per-value ``sizes``/``ttls``) shard on the put axis; store shards
     on the node axis; P and N must divide the mesh size.  ``now`` is
-    traced (a changing sim-time must not recompile).
+    traced (a changing sim-time must not recompile).  ``probe``
+    enables the reference's two-phase announce-with-probe (see
+    :func:`_probe_refresh`; best for re-announces — a first put of
+    fresh keys pays the probe for nothing).
     """
     n_shards = mesh.shape[AXIS]
     p = keys.shape[0]
@@ -283,7 +421,8 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
         payloads = jnp.zeros((p, scfg.payload_words), jnp.uint32)
     specs = _store_specs(mesh)
     fn = jax.shard_map(
-        partial(_announce_body, cfg, scfg, n_shards, capacity_factor),
+        partial(_announce_body, cfg, scfg, n_shards, capacity_factor,
+                probe, full_capacity_factor),
         mesh=mesh,
         in_specs=(P(), P(AXIS, None), P(), specs, P(AXIS, None),
                   P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS, None),
@@ -332,7 +471,8 @@ def sharded_empty_store(n_nodes: int, scfg: StoreConfig,
 # ---------------------------------------------------------------------------
 
 def _republish_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
-                    capacity_factor: float, ids, tables_local, alive,
+                    capacity_factor: float, probe: bool,
+                    full_capacity_factor, ids, tables_local, alive,
                     store_local: SwarmStore, key, now):
     """Per-shard maintenance sweep: every alive node OF THIS SHARD
     re-announces everything it stores — routed lookup over the stored
@@ -358,15 +498,19 @@ def _republish_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
         shard_n * scfg.slots, store_local.payload.shape[-1])
     store_local, replicas = _insert_routed(
         cfg, scfg, n_shards, capacity_factor, alive, store_local,
-        found, keys, vals, seqs, sizes, ttls, now, payloads)
+        found, keys, vals, seqs, sizes, ttls, now, payloads,
+        probe=probe, full_capacity_factor=full_capacity_factor)
     return store_local, replicas, hops, done
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "scfg", "mesh", "capacity_factor"))
+         static_argnames=("cfg", "scfg", "mesh", "capacity_factor",
+                          "probe", "full_capacity_factor"))
 def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                       scfg: StoreConfig, now, key: jax.Array,
-                      mesh: Mesh, capacity_factor: float = 4.0
+                      mesh: Mesh, capacity_factor: float = 4.0,
+                      probe: bool = False,
+                      full_capacity_factor: float | None = None
                       ) -> Tuple[SwarmStore, AnnounceReport]:
     """Mesh-wide storage maintenance: every alive node re-announces its
     stored values to the keys' current quorum-closest — the sharded
@@ -376,11 +520,22 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     batch is ``(N/D)·slots`` per shard; over-capacity requests drop
     and are healed by the next sweep, like the reference's rate-limited
     maintenance catching up over successive 10-min periods.
+
+    ``probe=True`` runs the two-phase announce-with-probe — pair it
+    with a ``full_capacity_factor`` well below ``capacity_factor``
+    (e.g. expected churn-lost fraction × capacity_factor): that is
+    where the wire saving lands, since capacity buckets ship full-size
+    regardless of fill.  With the default (full) provisioning a probe
+    sweep COSTS 9 extra words per slot; maintenance is exactly the
+    workload where a shrunk full phase is safe, because most replicas
+    answer the probe with a refresh (``bench.py --mode repub``
+    measures the trade).
     """
     n_shards = mesh.shape[AXIS]
     specs = _store_specs(mesh)
     fn = jax.shard_map(
-        partial(_republish_body, cfg, scfg, n_shards, capacity_factor),
+        partial(_republish_body, cfg, scfg, n_shards, capacity_factor,
+                probe, full_capacity_factor),
         mesh=mesh,
         in_specs=(P(), P(AXIS, None), P(), specs, P(), P()),
         out_specs=(specs, P(AXIS), P(AXIS), P(AXIS)),

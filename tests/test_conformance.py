@@ -127,6 +127,16 @@ def test_host_device_conformance(host_cluster):
     assert h_eff <= 1.5 * max(d_eff, 1) and d_eff <= 1.5 * max(h_eff, 1), (
         h_eff, d_eff)
 
+    # Tail effort (p90): a device engine with a fat convergence tail
+    # would pass the mean band and still be a different algorithm in
+    # practice — bound the distribution, not just its center (tails
+    # are noisier than means, hence the wider 2× band).
+    h_p90 = float(np.percentile(h_rounds, 90))
+    d_p90 = float(np.percentile(np.asarray(d_hops, float), 90))
+    assert d_p90 <= 16 and h_p90 <= 16, (h_p90, d_p90)
+    assert h_p90 <= 2.0 * max(d_p90, 1) and d_p90 <= 2.0 * max(h_p90, 1), (
+        h_p90, d_p90)
+
 
 # ---------------------------------------------------------------------------
 # storage-semantics leg: same op sequence, both engines, same outcomes
@@ -139,6 +149,140 @@ def test_host_device_conformance(host_cluster):
 # different data is rejected; stale seq is rejected.
 SEQ_STEPS = [(5, 1), (4, 2), (6, 3), (6, 4), (2, 5), (7, 6)]
 SEQ_EXPECT = [1, 1, 3, 3, 3, 6]
+SEQ_EXPECT_SEQ = [5, 5, 6, 6, 6, 7]
+
+
+def check_replica_outcomes(step, pairs):
+    """Assert the policy outcome over observed replica (seq, tag) pairs.
+
+    A replica that an earlier announce never reached may legitimately
+    hold a different same-seq tag (e.g. one that missed (6,3) accepts
+    (6,4)), so a bare freshest-replica max is a latent flake.  The
+    robust policy claims: the fully-delivered outcome exists on at
+    least one replica, and nothing fresher than it can exist anywhere.
+    """
+    exp = (SEQ_EXPECT_SEQ[step], SEQ_EXPECT[step])
+    assert exp in pairs, (step, exp, sorted(pairs))
+    assert max(s for s, _ in pairs) == exp[0], (step, sorted(pairs))
+
+
+# ---------------------------------------------------------------------------
+# maintenance leg: churn → republish → survival, both engines, one band
+# ---------------------------------------------------------------------------
+
+KILL_FRAC = 0.5
+CHURN_CYCLES = 2
+
+
+def host_maintenance_survival():
+    """Two kill-half cycles through the host cluster with storage
+    maintenance between them (``Dht::dataPersistence``, ref
+    src/dht.cpp:2887-2947): put values, partition half the nodes,
+    let maintenance republish, repeat, then re-get from a survivor.
+
+    The maintenance period is shrunk (white-box) so two full republish
+    sweeps fit inside the values' 10-min TTL on the virtual clock.
+    """
+    import opendht_tpu.core.dht as core_dht
+    from opendht_tpu.core.value import Value
+
+    old_period = core_dht.MAX_STORAGE_MAINTENANCE_EXPIRE_TIME
+    core_dht.MAX_STORAGE_MAINTENANCE_EXPIRE_TIME = 20.0
+    try:
+        n, n_vals = 64, 48
+        c = SimCluster(n, seed=13)
+        for d in c.nodes:
+            d.config.maintain_storage = True   # the ref opt-in flag
+        c.interconnect()
+        c.run(20.0)
+        rng = np.random.default_rng(5)
+        writer = c.nodes[0]
+        keys = [InfoHash(rng.bytes(20)) for _ in range(n_vals)]
+        for i, h in enumerate(keys):
+            done = []
+            writer.put(h, Value(f"v{i}".encode()),
+                       lambda ok, ns: done.append(ok))
+            c.run_until(lambda: done, timeout=60.0)
+        c.run(5.0)
+
+        alive = list(c.nodes)
+        for cycle in range(CHURN_CYCLES):
+            # The writer dies in cycle 0 (its local replicas must not
+            # mask replica survival — device announces store nothing
+            # at the origin).
+            doomed = [d for d in alive
+                      if rng.random() < KILL_FRAC or
+                      (cycle == 0 and d is writer)]
+            for d in doomed:
+                c.kill(d)
+            alive = [d for d in alive if d not in doomed]
+            assert len(alive) >= 4, "churn killed nearly everything"
+            # Two maintenance periods: displaced holders republish.
+            c.run(45.0)
+
+        reader = alive[-1]
+        found = 0
+        for h in keys:
+            got = []
+            done = []
+            reader.get(h, lambda vs: got.extend(vs) or True,
+                       lambda ok, ns: done.append(ok))
+            c.run_until(lambda: done, timeout=120.0)
+            if got:
+                found += 1
+        return found / n_vals
+    finally:
+        core_dht.MAX_STORAGE_MAINTENANCE_EXPIRE_TIME = old_period
+
+
+def device_maintenance_survival():
+    """The same two kill-half cycles through the device engine:
+    churn → ``republish_from`` every alive node → re-get
+    (models/storage, the sim ``dataPersistence``)."""
+    from opendht_tpu.models.storage import (
+        StoreConfig, announce, empty_store, get_values, republish_from,
+    )
+    from opendht_tpu.models.swarm import churn
+
+    cfg = SwarmConfig.for_nodes(2048)
+    sw = build_swarm(jax.random.PRNGKey(21), cfg)
+    scfg = StoreConfig(slots=8, listen_slots=2, max_listeners=64)
+    store = empty_store(cfg.n_nodes, scfg)
+    p = 512
+    keys = jax.random.bits(jax.random.PRNGKey(22), (p, 5), jnp.uint32)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    store, _ = announce(sw, cfg, store, scfg, keys, vals,
+                        jnp.ones((p,), jnp.uint32), 0,
+                        jax.random.PRNGKey(23))
+    all_idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+    dead = sw
+    for cycle in range(CHURN_CYCLES):
+        dead = churn(dead, jax.random.PRNGKey(30 + cycle), KILL_FRAC,
+                     cfg)
+        store, _ = republish_from(dead, cfg, store, scfg, all_idx,
+                                  1 + cycle,
+                                  jax.random.PRNGKey(40 + cycle))
+    res = get_values(dead, cfg, store, scfg, keys,
+                     jax.random.PRNGKey(50))
+    ok = np.asarray(res.hit) & (np.asarray(res.val) == np.asarray(vals))
+    return float(ok.mean())
+
+
+def test_maintenance_conformance():
+    """One spec, two engines — enforced for MAINTENANCE, not just
+    lookups: at a matched kill fraction and cycle count, the host
+    cluster's natural republish and the device engine's maintenance
+    sweep must land survival in the same band (ref scenario:
+    PersistenceTest, python/tools/dht/tests.py:439-827)."""
+    s_host = host_maintenance_survival()
+    s_dev = device_maintenance_survival()
+    # Theory floor at these parameters: one cycle loses a replica set
+    # with p = KILL_FRAC^8; with republish restoring replication
+    # between cycles, survival ≈ (1 - 0.5^8)^2 ≈ 0.992.  48-value host
+    # granularity and routing imperfection widen the band.
+    assert s_dev > 0.9, s_dev
+    assert s_host > 0.8, s_host
+    assert abs(s_host - s_dev) < 0.15, (s_host, s_dev)
 
 
 def test_storage_seq_semantics_host():
@@ -171,7 +315,6 @@ def test_storage_seq_semantics_host():
     all_ids = [d.myid for d in c.nodes]
     ranked = brute_closest(all_ids, bytes(key), len(all_ids))
     closest, farthest = ranked[:8], ranked[8:]
-    seen = []
     for step, (seq, tag) in enumerate(SEQ_STEPS):
         v = Value(bytes([tag]), value_id=77)
         v.seq = seq
@@ -181,14 +324,13 @@ def test_storage_seq_semantics_host():
         putter.put(key, v, lambda ok, ns: done.append(ok))
         c.run_until(lambda: done, timeout=60.0)
         c.run(1.0)
-        state = []
+        state = set()
         for i in closest:
             lv = c.nodes[i].get_local_by_id(key, 77)
             if lv is not None:
-                state.append((lv.seq, lv.data[0]))
+                state.add((lv.seq, lv.data[0]))
         assert state, f"step {step}: no replica stored"
-        seen.append(max(state)[1])
-    assert seen == SEQ_EXPECT, seen
+        check_replica_outcomes(step, state)
 
 
 def test_storage_seq_semantics_device():
@@ -205,7 +347,7 @@ def test_storage_seq_semantics_device():
     scfg = StoreConfig(slots=8, listen_slots=2, max_listeners=64)
     store = empty_store(cfg.n_nodes, scfg)
     key5 = jax.random.bits(jax.random.PRNGKey(42), (1, 5), jnp.uint32)
-    seen = []
+    kn = np.asarray(key5)[0]
     for step, (seq, tag) in enumerate(SEQ_STEPS):
         store, _ = announce(sw, cfg, store, scfg, key5,
                             jnp.asarray([tag], jnp.uint32),
@@ -214,9 +356,15 @@ def test_storage_seq_semantics_device():
         res = get_values(sw, cfg, store, scfg, key5,
                          jax.random.PRNGKey(200 + step))
         assert bool(res.hit[0]), f"step {step}: value not found"
-        seen.append(int(res.val[0]))
-    # The device announce path has no origin-side probe suppression
-    # (every request reaches the replicas and is judged by the store's
-    # edit policy), so its freshest-replica outcomes must equal the
-    # host's replica-state outcomes step for step.
-    assert seen == SEQ_EXPECT, seen
+        # Replica state read straight off the store tensors: the same
+        # policy claims as the host leg (check_replica_outcomes), plus
+        # the get must return one of the freshest replicas' tags.
+        m = np.asarray(store.used) \
+            & (np.asarray(store.keys) == kn).all(-1)
+        pairs = set(zip(np.asarray(store.seqs)[m].tolist(),
+                        np.asarray(store.vals)[m].tolist()))
+        assert pairs, f"step {step}: no replica stored"
+        check_replica_outcomes(step, pairs)
+        best = max(s for s, _ in pairs)
+        assert int(res.val[0]) in {t for s, t in pairs if s == best}, (
+            step, int(res.val[0]), sorted(pairs))

@@ -193,6 +193,78 @@ def test_sharded_republish_restores_replication_after_churn():
     assert bool(jnp.all(ok))
 
 
+def test_sharded_republish_probe_equal_survival():
+    """Announce-with-probe maintenance (ref probe-then-put,
+    dht.cpp:1237-1339): same survival as the full-payload sweep while
+    the full-value phase is provisioned at a fraction of capacity —
+    most replicas answer the probe with a refresh, not a transfer."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.models.swarm import churn
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_get,
+        sharded_republish,
+    )
+
+    cfg, sw, scfg, mesh, keys, vals, seqs = _mk_sharded_store_env()
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals, seqs,
+                                0, jax.random.PRNGKey(2), mesh,
+                                capacity_factor=float("inf"))
+    dead = churn(sw, jax.random.PRNGKey(7), 0.3, cfg)
+    store, rrep = sharded_republish(dead, cfg, store, scfg, 1,
+                                    jax.random.PRNGKey(8), mesh,
+                                    capacity_factor=float("inf"),
+                                    probe=True,
+                                    full_capacity_factor=float("inf"))
+    assert float(jnp.sum(rrep.replicas)) > 0
+    res = sharded_get(dead, cfg, store, scfg, keys,
+                      jax.random.PRNGKey(9), mesh,
+                      capacity_factor=float("inf"))
+    assert float(jnp.mean(res.hit)) > 0.9, float(jnp.mean(res.hit))
+    ok = jnp.where(res.hit, res.val == vals, True)
+    assert bool(jnp.all(ok))
+
+
+def test_sharded_announce_probe_refresh_counts_replicas():
+    """Re-announcing values the replicas already hold must complete via
+    probe+refresh alone: full replica counts even with the full-value
+    phase squeezed to near-zero capacity."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store,
+    )
+
+    cfg, sw, scfg, mesh, keys, vals, seqs = _mk_sharded_store_env(p=64)
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    store, rep1 = sharded_announce(sw, cfg, store, scfg, keys, vals,
+                                   seqs, 0, jax.random.PRNGKey(2), mesh,
+                                   capacity_factor=float("inf"))
+    store, rep2 = sharded_announce(sw, cfg, store, scfg, keys, vals,
+                                   seqs, 1, jax.random.PRNGKey(3), mesh,
+                                   capacity_factor=float("inf"),
+                                   probe=True,
+                                   full_capacity_factor=0.01)
+    r1 = float(jnp.mean(rep1.replicas))
+    r2 = float(jnp.mean(rep2.replicas))
+    assert r2 > 0.8 * r1, (r1, r2)
+
+
+def test_storage_wire_words_probe_shrinks_traffic():
+    """Static wire accounting: at maintenance (payload-bearing values,
+    small needy fraction) the probe shape must cost well under the
+    full-payload shape."""
+    from opendht_tpu.models.storage import StoreConfig
+    from opendht_tpu.parallel.sharded_storage import storage_wire_words
+
+    scfg = StoreConfig(slots=8, payload_words=16)
+    full = storage_wire_words(CFG, scfg, 4096, 8, 2.0)
+    probed = storage_wire_words(CFG, scfg, 4096, 8, 2.0, probe=True,
+                                full_capacity_factor=0.5)
+    assert probed < 0.65 * full, (probed, full)
+
+
 def test_sharded_expire_ttl_sweep():
     """Per-value TTLs must expire on the sharded store exactly as on
     the single-chip one (Storage::expire, src/dht.cpp:2361-2381)."""
@@ -240,6 +312,41 @@ def test_sharded_listen_notify_roundtrip():
                                 capacity_factor=float("inf"))
     notified = np.asarray(store.notified)[:64]
     assert notified.mean() > 0.9, notified.mean()
+    # The push delivered the VALUE, mesh-merged (tellListener payload,
+    # network_engine.cpp:161-173) — token and seq, not just a bit.
+    got_v = np.asarray(store.nvals)[:64]
+    got_s = np.asarray(store.nseqs)[:64]
+    assert (got_v[notified] == np.asarray(vals)[notified]).all()
+    assert (got_s[notified] == np.asarray(seqs)[notified] + 1).all()
+
+
+def test_sharded_listen_delivers_payload_bytes():
+    """Listener delivery slots must carry the announced BYTES across
+    the mesh merge (single-winner, no word blending)."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.models.storage import StoreConfig
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_listen_at,
+    )
+
+    cfg, sw, _, mesh, keys, vals, seqs = _mk_sharded_store_env(p=64)
+    scfg = StoreConfig(slots=8, listen_slots=2, max_listeners=256,
+                       payload_words=2)
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    reg_ids = jnp.arange(64, dtype=jnp.int32)
+    store, _ = sharded_listen_at(sw, cfg, store, scfg, keys, reg_ids,
+                                 jax.random.PRNGKey(2), mesh,
+                                 capacity_factor=float("inf"))
+    pls = jax.random.bits(jax.random.PRNGKey(5), (64, 2), jnp.uint32)
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals, seqs,
+                                0, jax.random.PRNGKey(3), mesh,
+                                capacity_factor=float("inf"),
+                                payloads=pls)
+    notified = np.asarray(store.notified)[:64]
+    assert notified.mean() > 0.9, notified.mean()
+    got = np.asarray(store.npayload)[:64]
+    assert (got[notified] == np.asarray(pls)[notified]).all()
 
 
 def test_sharded_announce_seq_edit_policy():

@@ -234,6 +234,52 @@ class TestListen:
         assert notified[:p // 2].mean() > 0.95, notified[:p // 2].mean()
         assert not notified[p // 2:].any()
 
+    def test_listen_delivers_value(self, small_swarm):
+        """The push carries the changed VALUE (token + seq + bytes),
+        not just a bit — ref tellListener sends the value list
+        (src/dht.cpp:2186-2225, network_engine.cpp:161-173)."""
+        swarm, cfg = small_swarm
+        scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024,
+                           payload_words=3)
+        store = empty_store(cfg.n_nodes, scfg)
+        p = 64
+        keys = _rand_keys(30, p)
+        regs = jnp.arange(p, dtype=jnp.int32)
+        store, _ = listen_at(swarm, cfg, store, scfg, keys, regs,
+                             jax.random.PRNGKey(31))
+        vals = jnp.arange(p, dtype=jnp.uint32) + 501
+        pls = jax.random.bits(jax.random.PRNGKey(32), (p, 3), jnp.uint32)
+        store, _ = announce(swarm, cfg, store, scfg, keys, vals,
+                            jnp.full((p,), 4, jnp.uint32), 0,
+                            jax.random.PRNGKey(33), payloads=pls)
+        n = np.asarray(store.notified)[:p]
+        assert n.mean() > 0.95
+        got_v = np.asarray(store.nvals)[:p]
+        got_s = np.asarray(store.nseqs)[:p]
+        got_pl = np.asarray(store.npayload)[:p]
+        assert (got_v[n] == np.asarray(vals)[n]).all()
+        assert (got_s[n] == 5).all()          # delivered seq + 1
+        assert (got_pl[n] == np.asarray(pls)[n]).all()
+
+    def test_listen_delivery_freshest_wins(self, small_swarm):
+        """A stale re-announce must not roll a listener's delivered
+        value back; a fresher one must replace it."""
+        swarm, cfg = small_swarm
+        scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=64)
+        store = empty_store(cfg.n_nodes, scfg)
+        key = _rand_keys(35, 1)
+        store, _ = listen_at(swarm, cfg, store, scfg, key,
+                             jnp.asarray([7], jnp.int32),
+                             jax.random.PRNGKey(36))
+        for seq, val in ((5, 50), (3, 30), (6, 60)):
+            store, _ = announce(swarm, cfg, store, scfg, key,
+                                jnp.asarray([val], jnp.uint32),
+                                jnp.asarray([seq], jnp.uint32), 0,
+                                jax.random.PRNGKey(40 + seq))
+        assert bool(store.notified[7])
+        assert int(store.nvals[7]) == 60
+        assert int(store.nseqs[7]) == 7       # delivered seq 6, +1
+
 
 class TestExpireRepublish:
     def test_expire_ttl(self, small_swarm):
