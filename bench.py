@@ -20,6 +20,7 @@ same JSON object.
 
 import argparse
 import json
+import math
 import time
 
 import jax
@@ -94,6 +95,11 @@ def main():
     ap.add_argument("--payload-words", type=int, default=0,
                     help="putget: attach real 4*W-byte value payloads "
                          "(verified on get); 0 = token-only store")
+    ap.add_argument("--value-parts", type=int, default=0,
+                    help="putget: store VARIABLE-size values spanning "
+                         "up to this many W-word slots per value "
+                         "(models.chunked_values; random per-value "
+                         "lengths, bytes+length verified on get)")
     ap.add_argument("--rounds", type=lambda s: max(1, int(s)), default=1,
                     help="churn mode: kill/republish cycles, min 1 "
                          "(the mult_time persistence scenario)")
@@ -110,6 +116,11 @@ def main():
         args.nodes = {"churn": 100_000, "sharded": 1_000_000,
                       "hotshard": 1_000_000,
                       "repub": 131_072}.get(args.mode, 10_000_000)
+    # Initialize the backend before any SwarmConfig exists: config
+    # construction itself must never touch the backend (dryrun
+    # invariant), so without this the HBM-derived cutoffs would size
+    # against the conservative fallback instead of memory_stats().
+    jax.devices()
     if args.mode == "putget":
         return putget_main(args)
     if args.mode == "churn":
@@ -226,7 +237,7 @@ def auto_slots(args, cfg):
     """
     if args.slots:
         return args.slots
-    from opendht_tpu.models.swarm import _pad128, device_hbm_bytes
+    from opendht_tpu.models.swarm import device_hbm_bytes, table_bytes
 
     # The bench always runs on a live device — initialize the backend
     # now so device_hbm_bytes() reads the real memory_stats() instead
@@ -236,10 +247,7 @@ def auto_slots(args, cfg):
         n_shards = 1          # local engine: whole state on one chip
     # Per-DEVICE shares: tables and the store shard over the node axis.
     n = cfg.n_nodes // n_shards
-    if cfg.aug_tables:
-        table = n * _pad128(cfg.n_buckets * 3 * cfg.bucket_k) * 2
-    else:
-        table = n * cfg.n_buckets * cfg.bucket_k * 4
+    table = table_bytes(cfg) // n_shards
     w = getattr(args, "payload_words", 0) or 0
     # keys 20 + five u32 scalars + used flag (+ payload words) per slot
     per_slot = n * (44 + 4 * w)
@@ -265,6 +273,8 @@ def putget_main(args):
     from opendht_tpu.models.swarm import SwarmConfig, build_swarm
 
     cfg = SwarmConfig.for_nodes(args.nodes)
+    if args.value_parts and not args.payload_words:
+        args.payload_words = 4
     scfg = StoreConfig(slots=auto_slots(args, cfg), listen_slots=4,
                        max_listeners=1 << 10,
                        payload_words=args.payload_words)
@@ -277,6 +287,9 @@ def putget_main(args):
     payloads = (jax.random.bits(jax.random.PRNGKey(8),
                                 (p, args.payload_words), jnp.uint32)
                 if args.payload_words else None)
+
+    if args.value_parts:
+        return putget_chunked(args, cfg, scfg, swarm, keys, vals, seqs)
 
     def roundtrip(seed):
         store = empty_store(cfg.n_nodes, scfg)
@@ -328,6 +341,73 @@ def putget_main(args):
               == np.asarray(payloads)[hit]).all()
         out["payload_bytes"] = 4 * args.payload_words
         out["payloads_intact"] = bool(ok)
+    print(json.dumps(out))
+
+
+def putget_chunked(args, cfg, scfg, swarm, keys, vals, seqs):
+    """Variable-size value round-trips: random per-value byte lengths
+    spanning 1..--value-parts fixed-width slots (models.chunked_values
+    — the reference's 64 KB variable values, value.h:73)."""
+    from opendht_tpu.models.chunked_values import (
+        announce_chunked, get_chunked,
+    )
+    from opendht_tpu.models.storage import empty_store
+
+    p, parts, w = args.puts, args.value_parts, args.payload_words
+    pls = jax.random.bits(jax.random.PRNGKey(8), (p, parts, w),
+                          jnp.uint32)
+    lens = (jax.random.randint(jax.random.PRNGKey(9), (p,), 1,
+                               parts * w * 4 + 1).astype(jnp.uint32))
+
+    def roundtrip(seed):
+        store = empty_store(cfg.n_nodes, scfg)
+        store, rep = announce_chunked(swarm, cfg, store, scfg, keys,
+                                      vals, seqs, 0,
+                                      jax.random.PRNGKey(seed), pls,
+                                      lens)
+        res = get_chunked(swarm, cfg, store, scfg, keys,
+                          jax.random.PRNGKey(seed + 1), parts)
+        return rep, res
+
+    def sync(res):
+        return int(np.asarray(jnp.sum(res.val[:8])))
+
+    rep, res = roundtrip(2)
+    sync(res)
+    times = []
+    for r in range(args.repeat):
+        t0 = time.perf_counter()
+        rep, res = roundtrip(10 + 2 * r)
+        sync(res)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+
+    hit = np.asarray(res.hit)
+    nw = -(-np.asarray(lens).astype(int) // 4)
+    got = np.asarray(res.payload)
+    want = np.asarray(pls).reshape(p, parts * w)
+    mask = np.arange(parts * w)[None, :] < nw[:, None]
+    intact = bool(((got == want) | ~mask)[hit].all())
+    out = {
+        "metric": "swarm_chunked_putget_roundtrips_per_sec",
+        "value": round(p / dt, 1),
+        "unit": "put+get/s",
+        "vs_baseline": round(p / dt / REFERENCE_LOOKUPS_PER_SEC, 2),
+        "n_nodes": args.nodes,
+        "n_puts": p,
+        "slots": scfg.slots,
+        "value_parts": parts,
+        "max_value_bytes": parts * w * 4,
+        "wall_s": round(dt, 4),
+        "hit_rate": float(hit.mean()),
+        "mean_replicas": float(np.asarray(rep.replicas).mean()),
+        "lengths_intact": bool(
+            (np.asarray(res.length)[hit]
+             == np.asarray(lens)[hit]).all()),
+        "payloads_intact": bool(intact),
+        "sim_fidelity": "variable-size-values",
+        "platform": jax.devices()[0].platform,
+    }
     print(json.dumps(out))
 
 
@@ -597,8 +677,36 @@ def sharded_main(args):
             "capacity_overhead_frac": round(t_shard / t_inf - 1, 4),
         }
 
-    # Storage round-trip: local vs routed announce+get.
+    res = chunked(
+        lambda c, s: sharded_lookup(swarm, cfg, c, jax.random.PRNGKey(s),
+                                    mesh, capacity_factor=2.0))(7)
+    out = {
+        "metric": "swarm_sharded_lookups_per_sec",
+        "value": round(l / t_shard, 1),
+        "unit": "lookups/s",
+        "vs_baseline": round(l / t_shard / REFERENCE_LOOKUPS_PER_SEC, 2),
+        "n_devices": n_dev,
+        "n_nodes": args.nodes,
+        "n_lookups": l,
+        "wall_s": round(t_shard, 4),
+        "local_wall_s": round(t_local, 4),
+        "lookup_overhead_frac": round(t_shard / t_local - 1, 4),
+        "done_frac": float(np.asarray(res.done).mean()),
+        "median_hops": float(np.median(np.asarray(res.hops))),
+        "capacity_factor": 2.0,
+        "lookup_batch": lb,
+        "platform": jax.devices()[0].platform,
+    }
+    if ladder:
+        out["decomposition"] = ladder
+
+    # Storage round-trip: local vs routed announce+get (skipped with
+    # --puts 0 — at 10M nodes the side-by-side stores next to the
+    # ~10 GB table fragment HBM; measure storage in its own process).
     p = args.puts
+    if p == 0:
+        print(json.dumps(out))
+        return
     scfg = StoreConfig(slots=auto_slots(args, cfg), listen_slots=4,
                        max_listeners=1 << 10)
     keys = jax.random.bits(jax.random.PRNGKey(4), (p, 5), jnp.uint32)
@@ -624,32 +732,10 @@ def sharded_main(args):
 
     t_pg_local = timed(local_putget, sync_g)
     t_pg_shard = timed(shard_putget, sync_g)
-
-    res = chunked(
-        lambda c, s: sharded_lookup(swarm, cfg, c, jax.random.PRNGKey(s),
-                                    mesh, capacity_factor=2.0))(7)
-    out = {
-        "metric": "swarm_sharded_lookups_per_sec",
-        "value": round(l / t_shard, 1),
-        "unit": "lookups/s",
-        "vs_baseline": round(l / t_shard / REFERENCE_LOOKUPS_PER_SEC, 2),
-        "n_devices": n_dev,
-        "n_nodes": args.nodes,
-        "n_lookups": l,
-        "wall_s": round(t_shard, 4),
-        "local_wall_s": round(t_local, 4),
-        "lookup_overhead_frac": round(t_shard / t_local - 1, 4),
-        "putget_wall_s": round(t_pg_shard, 4),
-        "putget_local_wall_s": round(t_pg_local, 4),
-        "putget_overhead_frac": round(t_pg_shard / t_pg_local - 1, 4),
-        "done_frac": float(np.asarray(res.done).mean()),
-        "median_hops": float(np.median(np.asarray(res.hops))),
-        "capacity_factor": 2.0,
-        "lookup_batch": lb,
-        "platform": jax.devices()[0].platform,
-    }
-    if ladder:
-        out["decomposition"] = ladder
+    out["putget_wall_s"] = round(t_pg_shard, 4)
+    out["putget_local_wall_s"] = round(t_pg_local, 4)
+    out["putget_overhead_frac"] = round(t_pg_shard / t_pg_local - 1, 4)
+    out["slots"] = scfg.slots
     print(json.dumps(out))
 
 
@@ -768,6 +854,14 @@ def repub_main(args):
         "steady_reduction": round(1 - ws_probe / ws_full, 4),
         "republish_wall_s_full": round(t_full, 3),
         "republish_wall_s_probe": round(t_probe, 3),
+        # The probe phase costs a flat 9 words/slot; it pays off iff
+        # the full-phase shrink saves more: (cf−fcf)·(11+W) > cf·9.
+        # At small payloads the reduction is legitimately NEGATIVE —
+        # that is the measured break-even, not a regression.  None =
+        # fcf saturated to cf (heavy churn): probing never pays.
+        "probe_breakeven_payload_words": (
+            max(0, math.ceil(9 * cf / (cf - fcf_churn)) - 11)
+            if cf > fcf_churn else None),
         "sim_fidelity": "payload-chunks",
         "platform": jax.devices()[0].platform,
     }

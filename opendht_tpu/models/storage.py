@@ -479,10 +479,14 @@ def announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
 @partial(jax.jit, static_argnames=("cfg",))
 def _get_probe(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                found: jax.Array, keys: jax.Array
-               ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+               ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
+                          jax.Array]:
     """Probe the stores of each get's closest queried nodes
     (``onGetValues`` replies, collected by ``onGetValuesDone``,
-    /root/reference/src/dht.cpp:3227-3297).  Freshest seq wins."""
+    /root/reference/src/dht.cpp:3227-3297).  Freshest seq wins.
+    Returns ``(hit, val, seq, payload, size)`` — size is the winning
+    replica's stored size (0 on miss), which chunked values use to
+    recover a value's true byte length from its part-0 slot."""
     n_safe = jnp.clip(found, 0, cfg.n_nodes - 1)
     ok = (found >= 0) & swarm.alive[n_safe]
     sk = store.keys[n_safe]                        # [P,Q,S,5]
@@ -499,7 +503,10 @@ def _get_probe(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     pl = _pick_payload(is_win,
                        store.payload[n_safe].reshape(p, is_win.shape[1],
                                                      -1), any_hit)
-    return any_hit, val, best_seq, pl
+    sz = _pick_payload(is_win,
+                       store.sizes[n_safe].reshape(p, is_win.shape[1],
+                                                   1), any_hit)[:, 0]
+    return any_hit, val, best_seq, pl, sz
 
 
 def _pick_payload(win: jax.Array, pls: jax.Array,
@@ -525,8 +532,8 @@ def get_values(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     hits, vals, seqs, pls = [], [], [], []
     for lo in range(0, p, chunk):
         hi = min(lo + chunk, p)
-        h, v, s, pl = _get_probe(swarm, cfg, store, res.found[lo:hi],
-                                 keys[lo:hi])
+        h, v, s, pl, _ = _get_probe(swarm, cfg, store, res.found[lo:hi],
+                                    keys[lo:hi])
         hits.append(h), vals.append(v), seqs.append(s), pls.append(pl)
     return GetResult(
         hit=jnp.concatenate(hits), val=jnp.concatenate(vals),

@@ -98,12 +98,24 @@ def device_hbm_bytes() -> int:
 _HBM_BYTES: int | None = None
 
 
+# Measured headroom for ids + ~500k-lookup per-step transients
+# (~4.5 GB at the 10M-node north-star config, BASELINE.md round 4 —
+# 16 GB chip → the calibrated 11.5 GB table cutoff).  Shared by the
+# aug-table cutoff, the bench auto-slots sizing and the sharded-lookup
+# while/burst dispatcher so the three HBM models cannot desynchronize.
+LOOKUP_HEADROOM_BYTES = 4_500_000_000
+
+
 def _aug_table_budget() -> int:
-    """HBM available to the augmented table: the device limit minus
-    measured headroom for ids + ~500k-lookup per-step transients
-    (~4.5 GB at the 10M-node north-star config, BASELINE.md round 4 —
-    16 GB chip → the calibrated 11.5 GB cutoff)."""
-    return device_hbm_bytes() - 4_500_000_000
+    """HBM available to the augmented table (see LOOKUP_HEADROOM_BYTES)."""
+    return device_hbm_bytes() - LOOKUP_HEADROOM_BYTES
+
+
+def table_bytes(cfg: "SwarmConfig") -> int:
+    """Exact device bytes of a swarm's routing table (padded rows)."""
+    if cfg.aug_tables:
+        return cfg.n_nodes * _pad128(cfg.n_buckets * 3 * cfg.bucket_k) * 2
+    return cfg.n_nodes * cfg.n_buckets * cfg.bucket_k * 4
 
 
 class SwarmConfig(NamedTuple):
@@ -757,30 +769,47 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     # Origins are drawn from *alive* nodes: the issuing node exists.
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
-    # First burst sized to the MEASURED convergence depth (pending-by-
-    # round on v5e-1, 500k uniform lookups: 100k nodes → 7 rounds, 1M →
-    # 8, 10M → 9 ≈ ceil(log2 N / 2.56)); every extra dispatched round
-    # costs a full-batch step (~97 ms at the north-star config) whether
-    # or not anything is pending, while an undershoot costs one ~100 ms
-    # scalar readback plus a 2-round top-up — so aim exactly and let
-    # the done-check loop absorb seed variance.
-    burst = min(cfg.max_steps,
-                max(6, math.ceil(math.log2(max(2, cfg.n_nodes)) / 2.56)))
-    rounds = 0
-    while rounds < cfg.max_steps:
-        n = min(burst, cfg.max_steps - rounds)
-        for _ in range(n):
-            st = lookup_step(swarm, cfg, st)
-        rounds += n
-        if bool(jnp.all(st.done)):
-            break
-        burst = 2
+    st = run_burst_loop(lambda s: lookup_step(swarm, cfg, s), st, cfg)
     # (A tail-compaction variant — argsort the active minority into a
     # quarter-width sub-batch after the burst — measured SLOWER at 10M:
     # 334.8k vs 357.6k lookups/s; the sort/gather/scatter and the extra
     # pending-count readback cost more than 2-3 cheaper tail rounds.)
     return LookupResult(found=_finalize(swarm.ids, st, cfg),
                         hops=st.hops, done=st.done)
+
+
+def burst_schedule(cfg: SwarmConfig) -> int:
+    """First-burst round count: the MEASURED convergence depth
+    (pending-by-round on v5e-1, 500k uniform lookups: 100k nodes → 7
+    rounds, 1M → 8, 10M → 9 ≈ ceil(log2 N / 2.56)).  Every extra
+    dispatched round costs a full-batch step (~97 ms at the north-star
+    config) whether or not anything is pending, while an undershoot
+    costs one ~100 ms scalar readback plus a 2-round top-up — so aim
+    exactly and let the done-check loop absorb seed variance.  The one
+    calibration constant shared by the local and sharded burst loops.
+    """
+    return min(cfg.max_steps,
+               max(6, math.ceil(math.log2(max(2, cfg.n_nodes)) / 2.56)))
+
+
+def run_burst_loop(step_fn, st: LookupState,
+                   cfg: SwarmConfig) -> LookupState:
+    """Host-driven round loop: dispatch ``burst_schedule`` rounds
+    back-to-back (they pipeline on the device), then check global
+    done-ness with one scalar readback, topping up 2 rounds at a time.
+    Finished lookups are frozen inside the step, so overshoot is
+    wall-clock waste only, never a semantics change."""
+    burst = burst_schedule(cfg)
+    rounds = 0
+    while rounds < cfg.max_steps:
+        n = min(burst, cfg.max_steps - rounds)
+        for _ in range(n):
+            st = step_fn(st)
+        rounds += n
+        if bool(jnp.all(st.done)):
+            break
+        burst = 2
+    return st
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_steps"))

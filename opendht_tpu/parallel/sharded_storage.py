@@ -10,7 +10,9 @@ design (``Dht::onAnnounce`` / ``onGetValues``,
 Both ops follow the same two-phase shape as the sharded lookup:
 
 1. the routed lock-step lookup finds each key's ``quorum`` closest
-   nodes (:func:`opendht_tpu.parallel.sharded._sharded_body`);
+   nodes (:func:`opendht_tpu.parallel.sharded.sharded_lookup`, which
+   itself dispatches between a while-loop and a host-burst
+   formulation on table size);
 2. storage requests — ``(owner-local row, key, value, seq)`` for
    announce, ``(owner-local row, key)`` probes for get — ship to the
    owning shard in the same fixed-capacity ``all_to_all`` buckets as
@@ -49,7 +51,7 @@ from ..models.storage import (
 from ..models.swarm import Swarm, SwarmConfig
 from ..ops.xor_metric import N_LIMBS
 from .mesh import AXIS
-from .sharded import _sharded_body
+from .sharded import sharded_lookup
 
 
 def _u2i(x: jax.Array) -> jax.Array:
@@ -70,18 +72,18 @@ def _cap_for(q: int, n_shards: int, capacity_factor: float) -> int:
 def _route_out(payload: jax.Array, owner: jax.Array, ok: jax.Array,
                n_shards: int, cap: int):
     """Ship ``payload [Q,W]`` rows to their owner shards in capacity-
-    ``cap`` buckets (same scheme as routing queries — see
-    ``_route_respond``).  Returns ``(rbuf [D,cap,W], pos, sent)``;
-    dropped rows have ``sent`` False."""
-    onehot = (owner[:, None] == jnp.arange(n_shards)[None, :]) \
-        & ok[:, None]
-    pos = jnp.take_along_axis(
-        jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1,
-        owner[:, None], axis=1)[:, 0]
-    sent = ok & (pos < cap)
-    qbuf = jnp.full((n_shards, cap + 1, payload.shape[1]), -1, jnp.int32)
-    qbuf = qbuf.at[jnp.where(sent, owner, n_shards - 1),
-                   jnp.where(sent, pos, cap)].set(payload)[:, :cap]
+    ``cap`` buckets (same sort-based scheme as routing queries — see
+    ``opendht_tpu.parallel.sharded._bucketize``; scatters and 2-D
+    fancy gathers run on the TPU's slow per-element paths).  Returns
+    ``(rbuf [D,cap,W], pos, sent)``; dropped rows have ``sent``
+    False."""
+    from .sharded import _bucketize
+
+    q = owner.shape[0]
+    src, pos, sent = _bucketize(owner, ok, n_shards, cap)
+    srcf = jnp.clip(src.reshape(-1), 0, max(q - 1, 0))
+    qbuf = jnp.where((src >= 0).reshape(-1, 1), payload[srcf],
+                     -1).reshape(n_shards, cap, payload.shape[1])
     rbuf = jax.lax.all_to_all(qbuf, AXIS, split_axis=0, concat_axis=0,
                               tiled=True)
     return rbuf, pos, sent
@@ -91,9 +93,11 @@ def _route_back(resp: jax.Array, owner: jax.Array, pos: jax.Array,
                 sent: jax.Array, cap: int) -> jax.Array:
     """Return per-request responses ``resp [D,cap,W]`` to their origin
     rows; unsent rows read -1."""
+    n_shards = resp.shape[0]
     back = jax.lax.all_to_all(resp, AXIS, split_axis=0, concat_axis=0,
                               tiled=True)
-    mine = back[owner, jnp.clip(pos, 0, cap - 1)]
+    slot = owner * cap + jnp.clip(pos, 0, cap - 1)
+    mine = back.reshape(n_shards * cap, -1)[slot]
     return jnp.where(sent[:, None], mine, -1)
 
 
@@ -287,29 +291,11 @@ def _merge_listener_state(store_local: SwarmStore) -> SwarmStore:
                                 nvals=nvals, npayload=npayload)
 
 
-def _announce_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
-                   capacity_factor: float, probe: bool,
-                   full_capacity_factor, ids, tables_local,
-                   alive, store_local: SwarmStore, keys, vals, seqs,
-                   sizes, ttls, payloads, key, now):
-    """Per-shard announce: routed lookup, then routed store inserts."""
-    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
-                                      ids, tables_local, alive, keys,
-                                      key)
-    store_local, replicas = _insert_routed(
-        cfg, scfg, n_shards, capacity_factor, alive, store_local,
-        found, keys, vals, seqs, sizes, ttls, now, payloads,
-        probe=probe, full_capacity_factor=full_capacity_factor)
-    return store_local, replicas, hops, done
-
-
-def _get_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
-              capacity_factor: float, ids, tables_local, alive,
-              store_local: SwarmStore, keys, key):
-    """Per-shard get: routed lookup, then routed store probes."""
-    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
-                                      ids, tables_local, alive, keys,
-                                      key)
+def _probe_phase_body(cfg: SwarmConfig, scfg: StoreConfig,
+                      n_shards: int, capacity_factor: float, alive,
+                      store_local: SwarmStore, found, keys):
+    """Per-shard get probes against the replicas a lookup ``found``
+    (the storage half of ``Dht::onGetValues``, freshest-seq wins)."""
     ll, quorum = found.shape
     shard_n = cfg.n_nodes // n_shards
     q = ll * quorum
@@ -363,7 +349,7 @@ def _get_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     # Single-replica pick across the quorum too (no word blending).
     out_pl = _pick_payload(win & (v == best_val[:, None]), q_pl,
                            jnp.any(h, axis=1))
-    return jnp.any(h, axis=1), best_val, best_seq, out_pl, hops, done
+    return jnp.any(h, axis=1), best_val, best_seq, out_pl
 
 
 def _store_specs(mesh: Mesh) -> SwarmStore:
@@ -390,6 +376,33 @@ def shard_store(store: SwarmStore, mesh: Mesh) -> SwarmStore:
 @partial(jax.jit,
          static_argnames=("cfg", "scfg", "mesh", "capacity_factor",
                           "probe", "full_capacity_factor"))
+def _sharded_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                    scfg: StoreConfig, found, keys, vals, seqs, sizes,
+                    ttls, payloads, now, mesh: Mesh,
+                    capacity_factor: float, probe: bool,
+                    full_capacity_factor):
+    """Jitted storage-insert phase: route the (replica, key, value)
+    requests of an already-completed lookup to their owner shards."""
+    n_shards = mesh.shape[AXIS]
+    specs = _store_specs(mesh)
+
+    def body(alive, store_local, found, keys, vals, seqs, sizes, ttls,
+             payloads, now):
+        return _insert_routed(cfg, scfg, n_shards, capacity_factor,
+                              alive, store_local, found, keys, vals,
+                              seqs, sizes, ttls, now, payloads,
+                              probe=probe,
+                              full_capacity_factor=full_capacity_factor)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), specs, P(AXIS, None), P(AXIS, None), P(AXIS),
+                  P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P()),
+        out_specs=(specs, P(AXIS)), check_vma=False)
+    return fn(swarm.alive, store, found, keys, vals, seqs, sizes, ttls,
+              payloads, jnp.uint32(now))
+
+
 def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                      scfg: StoreConfig, keys: jax.Array,
                      vals: jax.Array, seqs: jax.Array, now,
@@ -410,8 +423,13 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     enables the reference's two-phase announce-with-probe (see
     :func:`_probe_refresh`; best for re-announces — a first put of
     fresh keys pays the probe for nothing).
+
+    Two top-level phases — the routed lock-step lookup (which
+    dispatches between its while-loop and burst formulations on table
+    size, :func:`opendht_tpu.parallel.sharded.sharded_lookup`), then
+    the routed insert exchange — so big-table swarms never carry the
+    table through a device loop.
     """
-    n_shards = mesh.shape[AXIS]
     p = keys.shape[0]
     if sizes is None:
         sizes = jnp.ones((p,), jnp.uint32)
@@ -419,45 +437,43 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
         ttls = jnp.zeros((p,), jnp.uint32)
     if payloads is None:
         payloads = jnp.zeros((p, scfg.payload_words), jnp.uint32)
-    specs = _store_specs(mesh)
-    fn = jax.shard_map(
-        partial(_announce_body, cfg, scfg, n_shards, capacity_factor,
-                probe, full_capacity_factor),
-        mesh=mesh,
-        in_specs=(P(), P(AXIS, None), P(), specs, P(AXIS, None),
-                  P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS, None),
-                  P(), P()),
-        out_specs=(specs, P(AXIS), P(AXIS), P(AXIS)),
-        check_vma=False,
-    )
-    store, replicas, hops, done = fn(swarm.ids, swarm.tables,
-                                     swarm.alive, store, keys, vals,
-                                     seqs, sizes, ttls, payloads, key,
-                                     jnp.uint32(now))
-    return store, AnnounceReport(replicas=replicas, hops=hops, done=done)
+    res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
+    store, replicas = _sharded_insert(
+        swarm, cfg, store, scfg, res.found, keys, vals, seqs, sizes,
+        ttls, payloads, now, mesh, capacity_factor, probe,
+        full_capacity_factor)
+    return store, AnnounceReport(replicas=replicas, hops=res.hops,
+                                 done=res.done)
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "scfg", "mesh", "capacity_factor"))
-def sharded_get(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
-                scfg: StoreConfig, keys: jax.Array, key: jax.Array,
-                mesh: Mesh, capacity_factor: float = 4.0) -> GetResult:
-    """Batched get over the sharded swarm + store (freshest-seq wins)."""
+def _sharded_probe_phase(swarm: Swarm, cfg: SwarmConfig,
+                         store: SwarmStore, scfg: StoreConfig, found,
+                         keys, mesh: Mesh, capacity_factor: float):
     n_shards = mesh.shape[AXIS]
     specs = _store_specs(mesh)
     fn = jax.shard_map(
-        partial(_get_body, cfg, scfg, n_shards, capacity_factor),
+        partial(_probe_phase_body, cfg, scfg, n_shards,
+                capacity_factor),
         mesh=mesh,
-        in_specs=(P(), P(AXIS, None), P(), specs, P(AXIS, None),
-                  P()),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P(AXIS),
-                   P(AXIS)),
-        check_vma=False,
-    )
-    hit, val, seq, pl, hops, done = fn(swarm.ids, swarm.tables,
-                                       swarm.alive, store, keys, key)
-    return GetResult(hit=hit, val=val, seq=seq, hops=hops, done=done,
-                     payload=pl)
+        in_specs=(P(), specs, P(AXIS, None), P(AXIS, None)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS, None)),
+        check_vma=False)
+    return fn(swarm.alive, store, found, keys)
+
+
+def sharded_get(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                scfg: StoreConfig, keys: jax.Array, key: jax.Array,
+                mesh: Mesh, capacity_factor: float = 4.0) -> GetResult:
+    """Batched get over the sharded swarm + store (freshest-seq wins).
+    Same two-phase shape as :func:`sharded_announce`."""
+    res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
+    hit, val, seq, pl = _sharded_probe_phase(swarm, cfg, store, scfg,
+                                             res.found, keys, mesh,
+                                             capacity_factor)
+    return GetResult(hit=hit, val=val, seq=seq, hops=res.hops,
+                     done=res.done, payload=pl)
 
 
 def sharded_empty_store(n_nodes: int, scfg: StoreConfig,
@@ -470,56 +486,23 @@ def sharded_empty_store(n_nodes: int, scfg: StoreConfig,
 # storage maintenance on the mesh (republish / expire / listen)
 # ---------------------------------------------------------------------------
 
-def _republish_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
-                    capacity_factor: float, probe: bool,
-                    full_capacity_factor, ids, tables_local, alive,
-                    store_local: SwarmStore, key, now):
-    """Per-shard maintenance sweep: every alive node OF THIS SHARD
-    re-announces everything it stores — routed lookup over the stored
-    keys, then the same routed insert phase as announce."""
-    shard_n = cfg.n_nodes // n_shards
-    me = jax.lax.axis_index(AXIS)
-    local_alive = jax.lax.dynamic_slice_in_dim(
-        alive, me * shard_n, shard_n)
-    ok = local_alive[:, None] & store_local.used      # [shard_n, S]
-    keys = store_local.keys.reshape(-1, N_LIMBS)
-    vals = store_local.vals.reshape(-1)
-    seqs = store_local.seqs.reshape(-1)
-    sizes = store_local.sizes.reshape(-1)
-    ttls = store_local.ttls.reshape(-1)
-    okf = ok.reshape(-1)
-
-    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
-                                      ids, tables_local, alive, keys,
-                                      key)
-    # Dead/empty source slots announce to no one.
-    found = jnp.where(okf[:, None], found, -1)
-    payloads = store_local.payload.reshape(
-        shard_n * scfg.slots, store_local.payload.shape[-1])
-    store_local, replicas = _insert_routed(
-        cfg, scfg, n_shards, capacity_factor, alive, store_local,
-        found, keys, vals, seqs, sizes, ttls, now, payloads,
-        probe=probe, full_capacity_factor=full_capacity_factor)
-    return store_local, replicas, hops, done
-
-
-@partial(jax.jit,
-         static_argnames=("cfg", "scfg", "mesh", "capacity_factor",
-                          "probe", "full_capacity_factor"))
 def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                       scfg: StoreConfig, now, key: jax.Array,
                       mesh: Mesh, capacity_factor: float = 4.0,
                       probe: bool = False,
-                      full_capacity_factor: float | None = None
+                      full_capacity_factor: float | None = None,
+                      chunk: int = 262_144
                       ) -> Tuple[SwarmStore, AnnounceReport]:
     """Mesh-wide storage maintenance: every alive node re-announces its
     stored values to the keys' current quorum-closest — the sharded
     ``Dht::dataPersistence``/``maintainStorage``
     (/root/reference/src/dht.cpp:2887-2947), restoring replication
-    after churn without leaving the mesh.  The maintenance lookup
-    batch is ``(N/D)·slots`` per shard; over-capacity requests drop
-    and are healed by the next sweep, like the reference's rate-limited
-    maintenance catching up over successive 10-min periods.
+    after churn without leaving the mesh.  The maintenance batch is
+    every node's every slot (``N·slots`` lookups), processed in
+    mesh-divisible ``chunk``-sized pieces so even the 10M-node store
+    sweeps within HBM; over-capacity requests drop and are healed by
+    the next sweep, like the reference's rate-limited maintenance
+    catching up over successive 10-min periods.
 
     ``probe=True`` runs the two-phase announce-with-probe — pair it
     with a ``full_capacity_factor`` well below ``capacity_factor``
@@ -532,19 +515,38 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     measures the trade).
     """
     n_shards = mesh.shape[AXIS]
-    specs = _store_specs(mesh)
-    fn = jax.shard_map(
-        partial(_republish_body, cfg, scfg, n_shards, capacity_factor,
-                probe, full_capacity_factor),
-        mesh=mesh,
-        in_specs=(P(), P(AXIS, None), P(), specs, P(), P()),
-        out_specs=(specs, P(AXIS), P(AXIS), P(AXIS)),
-        check_vma=False,
-    )
-    store, replicas, hops, done = fn(swarm.ids, swarm.tables,
-                                     swarm.alive, store, key,
-                                     jnp.uint32(now))
-    return store, AnnounceReport(replicas=replicas, hops=hops, done=done)
+    s = scfg.slots
+    n = cfg.n_nodes
+    # Chunk by NODE RANGE, boundaries aligned to whole nodes and the
+    # mesh: each chunk slices the live store leaves directly (no
+    # full-store snapshot copies held across the sweep — at 10M nodes
+    # a keys+payload snapshot alone is GBs next to a ~10 GB table).
+    # Later chunks see earlier chunks' inserts, like the reference's
+    # maintenance iterating live storage.
+    cn = min(n, max(n_shards, (chunk // s) // n_shards * n_shards))
+    while n % cn:
+        cn -= n_shards
+    reps, hops, done = [], [], []
+    for i, nlo in enumerate(range(0, n, cn)):
+        nsl = slice(nlo, nlo + cn)
+        keys = store.keys[nsl].reshape(cn * s, N_LIMBS)
+        # Dead/empty source slots announce to no one (the republisher
+        # is the node OWNING the slot, so its aliveness gates the row).
+        okf = (swarm.alive[nsl, None] & store.used[nsl]).reshape(-1)
+        res = sharded_lookup(swarm, cfg, keys,
+                             jax.random.fold_in(key, i), mesh,
+                             capacity_factor)
+        found = jnp.where(okf[:, None], res.found, -1)
+        store, replicas = _sharded_insert(
+            swarm, cfg, store, scfg, found, keys,
+            store.vals[nsl].reshape(-1), store.seqs[nsl].reshape(-1),
+            store.sizes[nsl].reshape(-1), store.ttls[nsl].reshape(-1),
+            store.payload[nsl].reshape(cn * s, -1), now, mesh,
+            capacity_factor, probe, full_capacity_factor)
+        reps.append(replicas), hops.append(res.hops), done.append(res.done)
+    return store, AnnounceReport(replicas=jnp.concatenate(reps),
+                                 hops=jnp.concatenate(hops),
+                                 done=jnp.concatenate(done))
 
 
 def sharded_expire(store: SwarmStore, scfg: StoreConfig,
@@ -559,17 +561,14 @@ def sharded_expire(store: SwarmStore, scfg: StoreConfig,
 
 
 def _listen_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
-                 capacity_factor: float, ids, tables_local, alive,
-                 store_local: SwarmStore, keys, reg_ids, key):
-    """Per-shard listen: routed lookup, then routed listener-table
-    inserts (ring slots, ≤ listen_slots per node per batch) — the
-    sharded ``Dht::storageAddListener``
+                 capacity_factor: float, alive,
+                 store_local: SwarmStore, found, keys, reg_ids):
+    """Per-shard listen phase: routed listener-table inserts (ring
+    slots, ≤ listen_slots per node per batch) against the replicas a
+    lookup ``found`` — the sharded ``Dht::storageAddListener``
     (/root/reference/src/dht.cpp:2299-2322)."""
-    from ..models.storage import INT32_MAX, _pad1
+    from ..models.storage import INT32_MAX
 
-    found, hops, done = _sharded_body(cfg, n_shards, capacity_factor,
-                                      ids, tables_local, alive, keys,
-                                      key)
     ll, quorum = found.shape
     shard_n = cfg.n_nodes // n_shards
     q = ll * quorum
@@ -616,11 +615,23 @@ def _listen_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
         jnp.where(accept, s_node, 0)].add(accept.astype(jnp.uint32))
     store_local = store_local._replace(
         lkeys=lkeys, lids=lids, lcursor=store_local.lcursor + n_new)
-    return store_local, hops, done
+    return store_local
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "scfg", "mesh", "capacity_factor"))
+def _sharded_listen_phase(swarm, cfg, store, scfg, found, keys,
+                          reg_ids, mesh, capacity_factor):
+    n_shards = mesh.shape[AXIS]
+    specs = _store_specs(mesh)
+    fn = jax.shard_map(
+        partial(_listen_body, cfg, scfg, n_shards, capacity_factor),
+        mesh=mesh,
+        in_specs=(P(), specs, P(AXIS, None), P(AXIS, None), P(AXIS)),
+        out_specs=specs, check_vma=False)
+    return fn(swarm.alive, store, found, keys, reg_ids)
+
+
 def sharded_listen_at(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                       scfg: StoreConfig, keys: jax.Array,
                       reg_ids: jax.Array, key: jax.Array, mesh: Mesh,
@@ -628,18 +639,10 @@ def sharded_listen_at(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                       ) -> Tuple[SwarmStore, jax.Array]:
     """Batched listen over the mesh: register listener ``reg_ids [P]``
     for ``keys [P,5]`` at each key's quorum-closest nodes; subsequent
-    ``sharded_announce``/``sharded_republish`` of a key flip its
-    listeners' ``notified`` bits (merged mesh-wide via pmax)."""
-    n_shards = mesh.shape[AXIS]
-    specs = _store_specs(mesh)
-    fn = jax.shard_map(
-        partial(_listen_body, cfg, scfg, n_shards, capacity_factor),
-        mesh=mesh,
-        in_specs=(P(), P(AXIS, None), P(), specs, P(AXIS, None),
-                  P(AXIS), P()),
-        out_specs=(specs, P(AXIS), P(AXIS)),
-        check_vma=False,
-    )
-    store, hops, done = fn(swarm.ids, swarm.tables, swarm.alive, store,
-                           keys, reg_ids, key)
-    return store, done
+    ``sharded_announce``/``sharded_republish`` of a key push the
+    changed value into its listeners' delivery slots (merged
+    mesh-wide).  Same two-phase shape as :func:`sharded_announce`."""
+    res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
+    store = _sharded_listen_phase(swarm, cfg, store, scfg, res.found,
+                                  keys, reg_ids, mesh, capacity_factor)
+    return store, res.done

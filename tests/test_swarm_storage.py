@@ -339,6 +339,115 @@ class TestExpireRepublish:
         assert np.asarray(res.hit).mean() < 0.9
 
 
+class TestChunkedValues:
+    """Variable-size values across multiple fixed-width slots
+    (models/chunked_values — the device analogue of the reference's
+    64 KB values + MTU parts, value.h:73, network_engine.cpp:830-882)."""
+
+    def test_roundtrip_variable_lengths(self, small_swarm):
+        from opendht_tpu.models.chunked_values import (
+            announce_chunked, get_chunked,
+        )
+
+        swarm, cfg = small_swarm
+        scfg = StoreConfig(slots=16, listen_slots=2, max_listeners=64,
+                           payload_words=4)
+        store = empty_store(cfg.n_nodes, scfg)
+        p, parts, w = 32, 3, 4
+        keys = _rand_keys(60, p)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 9
+        seqs = jnp.full((p,), 2, jnp.uint32)
+        pls = jax.random.bits(jax.random.PRNGKey(61), (p, parts, w),
+                              jnp.uint32)
+        # Byte lengths spanning 1..parts slots, incl. exact multiples.
+        lens = jnp.asarray(
+            [(i % (parts * w * 4)) + 1 for i in range(p)], jnp.uint32)
+        lens = lens.at[0].set(w * 4)          # exactly one full slot
+        lens = lens.at[1].set(parts * w * 4)  # exactly all slots
+        store, rep = announce_chunked(swarm, cfg, store, scfg, keys,
+                                      vals, seqs, 0,
+                                      jax.random.PRNGKey(62), pls, lens)
+        assert float(np.asarray(rep.replicas).mean()) > 7
+        res = get_chunked(swarm, cfg, store, scfg, keys,
+                          jax.random.PRNGKey(63), parts)
+        hit = np.asarray(res.hit)
+        assert hit.mean() > 0.95, hit.mean()
+        assert (np.asarray(res.length)[hit]
+                == np.asarray(lens)[hit]).all()
+        assert (np.asarray(res.val)[hit] == np.asarray(vals)[hit]).all()
+        got = np.asarray(res.payload)                # [P, parts*W]
+        want = np.asarray(pls).reshape(p, parts * w)
+        nw = -(-np.asarray(lens).astype(int) // 4)
+        for i in range(p):
+            if hit[i]:
+                assert (got[i, :nw[i]] == want[i, :nw[i]]).all(), i
+                assert (got[i, nw[i]:] == 0).all(), i
+
+    def test_chunked_survives_churn_republish(self, small_swarm):
+        """Multi-part values must survive churn via the ordinary
+        republish path — parts are plain stored values."""
+        from opendht_tpu.models.chunked_values import (
+            announce_chunked, get_chunked,
+        )
+
+        swarm, cfg = small_swarm
+        scfg = StoreConfig(slots=16, listen_slots=2, max_listeners=64,
+                           payload_words=4)
+        store = empty_store(cfg.n_nodes, scfg)
+        p, parts, w = 32, 2, 4
+        keys = _rand_keys(70, p)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 3
+        pls = jax.random.bits(jax.random.PRNGKey(71), (p, parts, w),
+                              jnp.uint32)
+        lens = jnp.full((p,), parts * w * 4, jnp.uint32)
+        store, _ = announce_chunked(swarm, cfg, store, scfg, keys, vals,
+                                    jnp.ones((p,), jnp.uint32), 0,
+                                    jax.random.PRNGKey(72), pls, lens)
+        dead = churn(swarm, jax.random.PRNGKey(73), 0.4, cfg)
+        all_idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+        store, _ = republish_from(dead, cfg, store, scfg, all_idx, 1,
+                                  jax.random.PRNGKey(74))
+        res = get_chunked(dead, cfg, store, scfg, keys,
+                          jax.random.PRNGKey(75), parts)
+        hit = np.asarray(res.hit)
+        assert hit.mean() > 0.9, hit.mean()
+        got = np.asarray(res.payload)[hit]
+        want = np.asarray(pls).reshape(p, parts * w)[hit]
+        assert (got == want).all()
+
+    def test_torn_update_reads_as_missing_not_garbled(self):
+        """A fresher part-0 without its sibling part must fail the
+        completeness check (never mix old and new bytes)."""
+        from opendht_tpu.models.chunked_values import (
+            announce_chunked, get_chunked, part_key,
+        )
+        from opendht_tpu.models.storage import announce
+
+        cfg = SwarmConfig.for_nodes(256)
+        swarm = build_swarm(jax.random.PRNGKey(80), cfg)
+        scfg = StoreConfig(slots=16, listen_slots=2, max_listeners=64,
+                           payload_words=2)
+        store = empty_store(cfg.n_nodes, scfg)
+        key = _rand_keys(81, 1)
+        pls = jax.random.bits(jax.random.PRNGKey(82), (1, 2, 2),
+                              jnp.uint32)
+        lens = jnp.asarray([16], jnp.uint32)      # needs both parts
+        store, _ = announce_chunked(swarm, cfg, store, scfg, key,
+                                    jnp.asarray([5], jnp.uint32),
+                                    jnp.ones((1,), jnp.uint32), 0,
+                                    jax.random.PRNGKey(83), pls, lens)
+        # Tear: bump ONLY part 0 to seq 2 via a direct announce.
+        store, _ = announce(swarm, cfg, store, scfg, part_key(key, 0),
+                            jnp.asarray([5], jnp.uint32),
+                            jnp.full((1,), 2, jnp.uint32), 1,
+                            jax.random.PRNGKey(84),
+                            sizes=lens,
+                            payloads=pls[:, 0])
+        res = get_chunked(swarm, cfg, store, scfg, key,
+                          jax.random.PRNGKey(85), 2)
+        assert not bool(res.hit[0])
+
+
 def test_byte_budget_rejects_oversize(small_swarm):
     """Per-node byte budget (the scaled 64 MB max_store_size,
     ref callbacks.h:72, storageStore src/dht.cpp:2227-2258): once a
