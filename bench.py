@@ -115,7 +115,7 @@ def main():
     if args.nodes is None:
         args.nodes = {"churn": 100_000, "sharded": 1_000_000,
                       "hotshard": 1_000_000,
-                      "repub": 131_072}.get(args.mode, 10_000_000)
+                      "repub": 65_536}.get(args.mode, 10_000_000)
     # Initialize the backend before any SwarmConfig exists: config
     # construction itself must never touch the backend (dryrun
     # invariant), so without this the HBM-derived cutoffs would size
@@ -255,9 +255,14 @@ def auto_slots(args, cfg):
     # lkeys 80 B + lids 16 B) + cursors — ~1 GB at 10M nodes, NOT
     # negligible against the transient reserve.
     fixed = n * (4 * 24 + 8)
+    # 3.5 GB transient reserve: measured — slots=3 at 10M (reserve 3.0)
+    # OOMed the get's lookup bursts next to the 10.2 GB table.
     free = device_hbm_bytes() - table - 20 * cfg.n_nodes - fixed \
-        - 3_000_000_000
-    return int(max(2, min(16, free // max(per_slot, 1))))
+        - 3_500_000_000
+    # 2× per slot: the runtime does no input-output aliasing through
+    # the jit boundary, so every store-mutating op holds the slot
+    # leaves TWICE (in + out) at its peak.
+    return int(max(2, min(16, free // max(2 * per_slot, 1))))
 
 
 def putget_main(args):
@@ -272,10 +277,16 @@ def putget_main(args):
     )
     from opendht_tpu.models.swarm import SwarmConfig, build_swarm
 
-    cfg = SwarmConfig.for_nodes(args.nodes)
+    kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    cfg = SwarmConfig.for_nodes(args.nodes, **kw)
     if args.value_parts and not args.payload_words:
         args.payload_words = 4
-    scfg = StoreConfig(slots=auto_slots(args, cfg), listen_slots=4,
+    # listen_slots=1 at 10M: the put/get throughput bench registers no
+    # listeners, and idle [N,4,...] listener tables cost ~1 GB next to
+    # the 10.2 GB routing table (the listen path has its own tests and
+    # dryrun assertions).
+    scfg = StoreConfig(slots=auto_slots(args, cfg),
+                       listen_slots=1 if args.nodes >= 4_000_000 else 4,
                        max_listeners=1 << 10,
                        payload_words=args.payload_words)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
@@ -426,7 +437,8 @@ def churn_main(args):
     )
     from opendht_tpu.models.swarm import SwarmConfig, build_swarm, churn
 
-    cfg = SwarmConfig.for_nodes(args.nodes)
+    kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    cfg = SwarmConfig.for_nodes(args.nodes, **kw)
     scfg = StoreConfig(slots=auto_slots(args, cfg), listen_slots=4,
                        max_listeners=1 << 10)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
@@ -772,7 +784,9 @@ def repub_main(args):
                        max_listeners=1 << 10, payload_words=w)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
     _ = np.asarray(swarm.tables[:1, :1])
-    p = args.puts
+    # Puts bounded well under store capacity (n·slots): a ring-evicting
+    # overfull store measures eviction, not maintenance.
+    p = min(args.puts, cfg.n_nodes * scfg.slots // 16)
     keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
     vals = jnp.arange(p, dtype=jnp.uint32) + 1
     seqs = jnp.ones((p,), jnp.uint32)
@@ -780,8 +794,11 @@ def repub_main(args):
     cf = 4.0
     kf = args.kill_frac
     # Full-value phase provisioning under probe: sized to the expected
-    # churn-displaced fraction (+ headroom), not the full announce load.
-    fcf_churn = min(cf, cf * kf + 0.8)
+    # churn-displaced fraction (+ headroom), not the full announce
+    # load.  Kept BELOW 1.0 — per-shard capacity clamps at the actual
+    # request count, so on a 1-device mesh any factor ≥ 1 ships
+    # identical buckets and the probe saving would read as zero.
+    fcf_churn = min(cf, 2 * kf + 0.2)
     fcf_steady = 0.5
 
     def run_cycles(probe, seed):

@@ -83,6 +83,10 @@ def announce_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     p, parts, w = payloads.shape
     assert w == scfg.payload_words, (w, scfg.payload_words)
     res = lookup(swarm, cfg, keys, rng)
+    # Clamp to what ``payloads`` can actually represent: an oversize
+    # recorded length would store unreadable-forever parts (the reader
+    # rejects need_words > parts·w), silently wasting replica budget.
+    lengths = jnp.minimum(lengths, jnp.uint32(parts * w * 4))
     words = -(-lengths.astype(jnp.int32) // 4)               # [P]
     rep0 = None
     for j in range(parts):
@@ -91,8 +95,8 @@ def announce_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
         sizes_j = (jnp.maximum(lengths, 1).astype(jnp.uint32) if j == 0
                    else jnp.ones_like(lengths, jnp.uint32))
         store, rep = _announce_insert(
-            swarm, cfg, store, scfg, found_j, part_key(keys, j), vals,
-            seqs, jnp.uint32(now), sizes_j, None, payloads[:, j])
+            swarm.alive, cfg, store, scfg, found_j, part_key(keys, j),
+            vals, seqs, jnp.uint32(now), sizes_j, None, payloads[:, j])
         if j == 0:
             rep0 = rep
     return store, AnnounceReport(replicas=rep0, hops=res.hops,
@@ -111,8 +115,8 @@ def get_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     """
     w = scfg.payload_words
     res = lookup(swarm, cfg, keys, rng)
-    h0, val, seq, pl0, sz = _get_probe(swarm, cfg, store, res.found,
-                                       keys)
+    h0, val, seq, pl0, sz = _get_probe(swarm.alive, cfg, store, scfg,
+                                       res.found, keys)
     need_words = -(-sz.astype(jnp.int32) // 4)               # [P]
     n_parts = jnp.clip(-(-need_words // max(w, 1)), 1, parts)
     # A value longer than the caller's ``parts`` budget must read as
@@ -121,8 +125,8 @@ def get_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     ok = h0 & (need_words <= parts * w)
     pls = [pl0]
     for j in range(1, parts):
-        hj, vj, sj, plj, _ = _get_probe(swarm, cfg, store, res.found,
-                                        part_key(keys, j))
+        hj, vj, sj, plj, _ = _get_probe(swarm.alive, cfg, store, scfg,
+                                        res.found, part_key(keys, j))
         needed = n_parts > j
         ok = ok & (~needed | (hj & (vj == val) & (sj == seq)))
         pls.append(jnp.where(needed[:, None], plj, 0))

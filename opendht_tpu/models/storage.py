@@ -56,21 +56,77 @@ from .swarm import LookupResult, Swarm, SwarmConfig, lookup
 INT32_MAX = 0x7FFFFFFF
 
 
-def _pad1(a: jax.Array) -> jax.Array:
-    """Append one trash row: masked scatter rows are routed there,
-    because duplicate-index ``.set`` order is unspecified in XLA and
-    inactive rows must never touch live cells."""
-    return jnp.concatenate([a, jnp.zeros((1,) + a.shape[1:], a.dtype)],
-                           axis=0)
+def _pl_gather(flat1: jax.Array, row: jax.Array, w: int) -> jax.Array:
+    """Gather payload rows ``[..., w]`` from the FLAT 1-D payload view.
+
+    Multi-GB payload operands with a small (non-128) minor dim crash
+    the TPU compiler on 2-D/3-D gathers (measured at 10M nodes, W=8 —
+    the same non-lane-aligned-minor failure mode as the table layout
+    work, BASELINE.md round 4); W per-column 1-D gathers are pad-free
+    and compile clean.  ``row`` is a slot-row index (node·S + slot);
+    element index ``row·w + j`` must stay below 2³¹ — (N+1)·S·W <
+    2^31, ample for every real config (10M × 16 slots × 8 words =
+    1.3e9).
+    """
+    idx = row[..., None] * w + jnp.arange(w, dtype=jnp.int32)
+    return flat1[idx]
 
 
-def _mask_dead(swarm: Swarm, cfg: SwarmConfig,
-               req_node: jax.Array) -> jax.Array:
+def _pl_scatter(flat1: jax.Array, row: jax.Array, vals: jax.Array,
+                w: int) -> jax.Array:
+    """Scatter payload rows ``vals [..., w]`` into the flat 1-D view
+    as ONE element scatter with an ``[..., w]`` index array (a
+    per-column loop of w chained scatters held w full-array versions
+    live — measured 25 GB at W=64; see :func:`_pl_gather` for why the
+    operand must be flat).  Out-of-bounds rows (masked requests)
+    drop."""
+    idx = row[..., None] * w + jnp.arange(w, dtype=jnp.int32)
+    return flat1.at[idx].set(vals, mode="drop")
+
+
+def _key_match(flat_keys: jax.Array, node: jax.Array, n_slots: int,
+               key: jax.Array) -> jax.Array:
+    """``[..., n_slots]`` bool: does ``node``'s slot j hold ``key``?
+
+    Per-column 1-D gathers over the FLAT ``[N·S·5]`` key store —
+    materializing a ``[N,S,5]`` key array for 3-D gathers acquires a
+    transposed tiled-layout copy (measured 25.6 GB at 10M nodes,
+    slots=5), while 1-D element gathers are pad-free.  ``node``
+    broadcasts against ``key[..., l]``.
+    """
+    cols = []
+    for j in range(n_slots):
+        m = None
+        for l in range(N_LIMBS):
+            g = flat_keys[(node * n_slots + j) * N_LIMBS + l] \
+                == key[..., l]
+            m = g if m is None else (m & g)
+        cols.append(m)
+    return jnp.stack(cols, axis=-1)
+
+
+def _key_rows(flat_keys: jax.Array, row: jax.Array) -> jax.Array:
+    """Gather whole 5-limb keys ``[..., 5]`` by slot-row index from the
+    flat key store (one element gather, see :func:`_key_match`)."""
+    idx = row[..., None] * N_LIMBS + jnp.arange(N_LIMBS, dtype=jnp.int32)
+    return flat_keys[idx]
+
+
+def _key_write(flat_keys: jax.Array, row: jax.Array,
+               key: jax.Array) -> jax.Array:
+    """Scatter 5-limb keys by slot-row index, one element scatter
+    (OOB rows drop)."""
+    idx = row[..., None] * N_LIMBS + jnp.arange(N_LIMBS, dtype=jnp.int32)
+    return flat_keys.at[idx].set(key, mode="drop")
+
+
+def _mask_dead_idx(alive: jax.Array, cfg: SwarmConfig,
+                   req_node: jax.Array) -> jax.Array:
     """-1 out requests aimed at dead or invalid nodes (dead replicas
     never ack — the reference's expired announce targets)."""
     return jnp.where(
         (req_node >= 0)
-        & swarm.alive[jnp.clip(req_node, 0, cfg.n_nodes - 1)],
+        & alive[jnp.clip(req_node, 0, cfg.n_nodes - 1)],
         req_node, -1)
 
 
@@ -106,19 +162,27 @@ class StoreConfig(NamedTuple):
 
 class SwarmStore(NamedTuple):
     """Per-node value store + listener table (a pytree of arrays)."""
-    keys: jax.Array      # [N,S,5] uint32 — stored key hashes
+    # Stored key hashes, FLAT [N·S·5] uint32 (slot-row r = node·S +
+    # slot owns limbs [r·5, r·5+5)) — same flat-layout rule as
+    # ``payload`` below.
+    keys: jax.Array      # [N*S*5] uint32 — stored key hashes
     vals: jax.Array      # [N,S] uint32   — value tokens
     seqs: jax.Array      # [N,S] uint32   — sequence numbers
     created: jax.Array   # [N,S] uint32   — sim-time of storage
     used: jax.Array      # [N,S] bool
     cursor: jax.Array    # [N] uint32     — ring write position
-    lkeys: jax.Array     # [N,LS,5] uint32 — listened-for keys
-    lids: jax.Array      # [N,LS] int32    — listener registration id, -1
+    lkeys: jax.Array     # [N*LS*5] uint32 — listened-for keys (flat)
+    lids: jax.Array      # [N*LS] int32 — listener registration id, -1 (flat)
     lcursor: jax.Array   # [N] uint32
     notified: jax.Array  # [max_listeners] bool — listener got a push
     sizes: jax.Array     # [N,S] uint32   — stored value sizes
     ttls: jax.Array      # [N,S] uint32   — per-value ttl (0 = cfg.ttl)
-    payload: jax.Array   # [N,S,W] uint32 — value bytes (W = 0: tokens only)
+    # Value bytes, FLAT [N·S·W] uint32 (slot-row r = node·S + slot
+    # owns elements [r·W, (r+1)·W); W = 0: tokens only).  Flat because
+    # a [N,S,W] form acquires a tiled device layout whose small minor
+    # dims pad 8×128 — measured 25.6× expansion (40.96 GB for the
+    # 1.6 GB 10M-node payload store); 1-D tiles linearly, pad-free.
+    payload: jax.Array   # [N*S*W] uint32 — value bytes
     # Listener DELIVERY slots: what ``tellListener`` pushed — the
     # changed value itself, not just a "something changed" bit
     # (/root/reference/src/dht.cpp:2186-2225,
@@ -150,19 +214,19 @@ class GetResult(NamedTuple):
 def empty_store(n_nodes: int, scfg: StoreConfig) -> SwarmStore:
     n, s, ls = n_nodes, scfg.slots, scfg.listen_slots
     return SwarmStore(
-        keys=jnp.zeros((n, s, N_LIMBS), jnp.uint32),
+        keys=jnp.zeros((n * s * N_LIMBS,), jnp.uint32),
         vals=jnp.zeros((n, s), jnp.uint32),
         seqs=jnp.zeros((n, s), jnp.uint32),
         created=jnp.zeros((n, s), jnp.uint32),
         used=jnp.zeros((n, s), bool),
         cursor=jnp.zeros((n,), jnp.uint32),
-        lkeys=jnp.zeros((n, ls, N_LIMBS), jnp.uint32),
-        lids=jnp.full((n, ls), -1, jnp.int32),
+        lkeys=jnp.zeros((n * ls * N_LIMBS,), jnp.uint32),
+        lids=jnp.full((n * ls,), -1, jnp.int32),
         lcursor=jnp.zeros((n,), jnp.uint32),
         notified=jnp.zeros((scfg.max_listeners,), bool),
         sizes=jnp.zeros((n, s), jnp.uint32),
         ttls=jnp.zeros((n, s), jnp.uint32),
-        payload=jnp.zeros((n, s, scfg.payload_words), jnp.uint32),
+        payload=jnp.zeros((n * s * scfg.payload_words,), jnp.uint32),
         nseqs=jnp.zeros((scfg.max_listeners,), jnp.uint32),
         nvals=jnp.zeros((scfg.max_listeners,), jnp.uint32),
         npayload=jnp.zeros((scfg.max_listeners, scfg.payload_words),
@@ -262,31 +326,34 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     live = s_valid & ~nxt_same
 
     # --- match against existing slots on the target node
-    n_safe = jnp.clip(s_node, 0, store.keys.shape[0] - 1)
-    slot_keys = store.keys[n_safe]                        # [M,S,5]
+    n_nodes = store.used.shape[0]
+    n_safe = jnp.clip(s_node, 0, n_nodes - 1)
     slot_used = store.used[n_safe]                        # [M,S]
-    km = slot_used & jnp.all(slot_keys == s_key[:, None, :], axis=-1)
+    km = slot_used & _key_match(store.keys, n_safe, s, s_key)  # [M,S]
     has_match = jnp.any(km, axis=-1)
     mslot = jnp.argmax(km, axis=-1).astype(jnp.int32)     # first match
-
-    n_nodes = store.keys.shape[0]
 
     first = jnp.searchsorted(s_node_sk, s_node_sk, side="left")
 
     # --- edit policy (monotone seq; equal seq only re-announces the
     # --- same value — token AND bytes, ref securedht.cpp:105-115
     # --- "if the data is exactly the same") and new-key candidacy
-    w = store.payload.shape[-1]
+    w = scfg.payload_words
     if w:
         s_pl = (jnp.zeros((m, w), jnp.uint32) if put_payloads is None
                 else put_payloads[
                     jnp.clip(s_put, 0, put_payloads.shape[0] - 1)])
+        # Payload ops run on the flat store, one column at a time
+        # (_pl_gather/_pl_scatter): any multi-element-minor form
+        # crashed the compiler at 10M nodes.  Trash indices land out
+        # of bounds and drop.
+        flat_pl = store.payload
     cur_seq = store.seqs[n_safe, mslot]
     cur_val = store.vals[n_safe, mslot]
     same = s_val == cur_val
     if w:
-        same = same & jnp.all(s_pl == store.payload[n_safe, mslot],
-                              axis=-1)
+        same = same & jnp.all(
+            s_pl == _pl_gather(flat_pl, n_safe * s + mslot, w), axis=-1)
     upd = live & has_match & (
         (s_seq > cur_seq) | ((s_seq == cur_seq) & same))
     new = live & ~has_match
@@ -322,20 +389,24 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
         cum = _segment_excl_sum(growth, first)
         upd = upd & (base + cum + jnp.maximum(delta, 0) <= budget)
         new = new & (base + cum + s_sz <= budget)
+    # Masked rows scatter to the OUT-OF-BOUNDS index n_nodes and are
+    # DROPPED (mode="drop") — no padded-copy trick: _pad1's
+    # concatenate forced a full copy of every store leaf, and at the
+    # 10M-node payload config those copies (on top of the runtime's
+    # un-aliased jit inputs/outputs) blew the program past HBM
+    # (measured 19.1 GB of 15.75 GB).
     un, us = jnp.where(upd, s_node, n_nodes), mslot
-    vals = _pad1(store.vals).at[un, us].set(s_val)
-    seqs = _pad1(store.seqs).at[un, us].set(s_seq)
-    created = _pad1(store.created).at[un, us].set(now)
-    sizes = _pad1(store.sizes).at[un, us].set(s_size)
-    ttls = _pad1(store.ttls).at[un, us].set(s_ttl)
+    vals = store.vals.at[un, us].set(s_val, mode="drop")
+    seqs = store.seqs.at[un, us].set(s_seq, mode="drop")
+    created = store.created.at[un, us].set(now, mode="drop")
+    sizes = store.sizes.at[un, us].set(s_size, mode="drop")
+    ttls = store.ttls.at[un, us].set(s_ttl, mode="drop")
     # Payload written unconditionally when enabled (zeros for a
     # payload-less announce): a slot's bytes must never outlive the
     # value that owned them — a ring-wrapped new key would otherwise
     # return the previous occupant's bytes on get.
     if w:
-        payload = _pad1(store.payload).at[un, us].set(s_pl)
-    else:
-        payload = _pad1(store.payload)
+        flat_pl = _pl_scatter(flat_pl, un * s + us, s_pl, w)
 
     # --- new-key path: ring-slot allocation, ≤ slots per node per batch
     rank = _segment_rank(s_node_sk, new, first)
@@ -346,30 +417,34 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     # accepted value.  Drop the new key instead — the reference's
     # reject-when-full (``storageStore`` returning false,
     # /root/reference/src/dht.cpp:2227-2258).
-    upd_map = _pad1(jnp.zeros_like(store.used)).at[un, us].set(upd)[:-1]
+    upd_map = jnp.zeros_like(store.used).at[un, us].set(
+        upd, mode="drop")
     conflict = upd_map[n_safe, slot]
     accept_new = new & (rank < s) & ~conflict
     nn = jnp.where(accept_new, s_node, n_nodes)
-    keys = _pad1(store.keys).at[nn, slot].set(s_key)[:-1]
-    vals = vals.at[nn, slot].set(s_val)[:-1]
-    seqs = seqs.at[nn, slot].set(s_seq)[:-1]
-    created = created.at[nn, slot].set(now)[:-1]
-    sizes = sizes.at[nn, slot].set(s_size)[:-1]
-    ttls = ttls.at[nn, slot].set(s_ttl)[:-1]
+    keys = _key_write(store.keys, nn * s + slot, s_key)
+    vals = vals.at[nn, slot].set(s_val, mode="drop")
+    seqs = seqs.at[nn, slot].set(s_seq, mode="drop")
+    created = created.at[nn, slot].set(now, mode="drop")
+    sizes = sizes.at[nn, slot].set(s_size, mode="drop")
+    ttls = ttls.at[nn, slot].set(s_ttl, mode="drop")
     if w:
-        payload = payload.at[nn, slot].set(s_pl)[:-1]
+        flat_pl = _pl_scatter(flat_pl, nn * s + slot, s_pl, w)
+        payload = flat_pl
     else:
-        payload = payload[:-1]
-    used = _pad1(store.used).at[nn, slot].set(True)[:-1]
+        payload = store.payload
+    used = store.used.at[nn, slot].set(True, mode="drop")
     n_new = jnp.zeros_like(store.cursor).at[jnp.where(accept_new, s_node, 0)
                                             ].add(accept_new.astype(jnp.uint32))
     cursor = store.cursor + n_new
 
     # --- listener notification (storageChanged → tellListener)
     accepted = upd | accept_new
-    lk = store.lkeys[n_safe]                              # [M,LS,5]
-    lid = store.lids[n_safe]                              # [M,LS]
-    lmatch = (lid >= 0) & jnp.all(lk == s_key[:, None, :], axis=-1) \
+    ls_n = store.lids.shape[0] // n_nodes                 # listen slots
+    lid = jnp.stack([store.lids[n_safe * ls_n + j]
+                     for j in range(ls_n)], axis=-1)      # [M,LS]
+    lmatch = (lid >= 0) \
+        & _key_match(store.lkeys, n_safe, ls_n, s_key) \
         & accepted[:, None]
     lid_safe = jnp.clip(lid, 0, store.notified.shape[0] - 1)
     notified = store.notified.at[
@@ -435,15 +510,21 @@ def _announce_targets(swarm: Swarm, cfg: SwarmConfig, keys: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("cfg", "scfg"))
-def _announce_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+def _announce_insert(alive: jax.Array, cfg: SwarmConfig,
+                     store: SwarmStore,
                      scfg: StoreConfig, res_found: jax.Array,
                      keys: jax.Array, vals: jax.Array, seqs: jax.Array,
                      now: jax.Array, sizes: jax.Array | None = None,
                      ttls: jax.Array | None = None,
                      payloads: jax.Array | None = None
                      ) -> Tuple[SwarmStore, jax.Array]:
+    # Takes the bare ``alive`` mask, NOT the whole swarm: the runtime
+    # keeps every jit input resident (no unused-arg pruning through the
+    # AOT tunnel), and a rides-along 10 GB routing table was the
+    # measured difference between compiling and a 19.1 GB HBM blowup
+    # at the 10M-node payload config.
     p, q = res_found.shape
-    req_node = _mask_dead(swarm, cfg, res_found.reshape(-1))
+    req_node = _mask_dead_idx(alive, cfg, res_found.reshape(-1))
     req_key = jnp.repeat(keys, q, axis=0)
     req_val = jnp.repeat(vals, q, axis=0)
     req_seq = jnp.repeat(seqs, q, axis=0)
@@ -470,14 +551,15 @@ def announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     [P, scfg.payload_words]``."""
     res = _announce_targets(swarm, cfg, keys, rng)
     store, replicas = _announce_insert(
-        swarm, cfg, store, scfg, res.found, keys, vals, seqs,
+        swarm.alive, cfg, store, scfg, res.found, keys, vals, seqs,
         jnp.uint32(now), sizes, ttls, payloads)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
                                  done=res.done)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def _get_probe(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+@partial(jax.jit, static_argnames=("cfg", "scfg"))
+def _get_probe(alive: jax.Array, cfg: SwarmConfig, store: SwarmStore,
+               scfg: StoreConfig,
                found: jax.Array, keys: jax.Array
                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
                           jax.Array]:
@@ -488,10 +570,11 @@ def _get_probe(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     replica's stored size (0 on miss), which chunked values use to
     recover a value's true byte length from its part-0 slot."""
     n_safe = jnp.clip(found, 0, cfg.n_nodes - 1)
-    ok = (found >= 0) & swarm.alive[n_safe]
-    sk = store.keys[n_safe]                        # [P,Q,S,5]
+    ok = (found >= 0) & alive[n_safe]
+    sslots = scfg.slots
     hit = store.used[n_safe] & ok[..., None] \
-        & jnp.all(sk == keys[:, None, None, :], axis=-1)   # [P,Q,S]
+        & _key_match(store.keys, n_safe, sslots,
+                     keys[:, None, :])                     # [P,Q,S]
     sseq = jnp.where(hit, store.seqs[n_safe], 0)
     best_seq = jnp.max(sseq, axis=(1, 2))
     is_best = hit & (sseq == best_seq[:, None, None])
@@ -500,12 +583,21 @@ def _get_probe(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     p = found.shape[0]
     is_win = (is_best & (store.vals[n_safe] == val[:, None, None])
               ).reshape(p, -1)                         # [P, Q*S]
-    pl = _pick_payload(is_win,
-                       store.payload[n_safe].reshape(p, is_win.shape[1],
-                                                     -1), any_hit)
-    sz = _pick_payload(is_win,
-                       store.sizes[n_safe].reshape(p, is_win.shape[1],
-                                                   1), any_hit)[:, 0]
+    # ONE winning replica's payload/size, fetched by flat slot-row
+    # index with per-column 1-D gathers (never an elementwise max
+    # across replicas, and never a small-minor gather on a multi-GB
+    # payload operand — see _pl_gather).
+    widx = jnp.argmax(is_win, axis=1).astype(jnp.int32)  # [P]
+    qi, si = widx // sslots, widx % sslots
+    node_w = jnp.take_along_axis(n_safe, qi[:, None], axis=1)[:, 0]
+    roww = node_w * sslots + si
+    w = scfg.payload_words
+    if w:
+        pl = jnp.where(any_hit[:, None],
+                       _pl_gather(store.payload, roww, w), 0)
+    else:
+        pl = jnp.zeros((p, 0), jnp.uint32)
+    sz = jnp.where(any_hit, store.sizes.reshape(-1)[roww], 0)
     return any_hit, val, best_seq, pl, sz
 
 
@@ -532,8 +624,8 @@ def get_values(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     hits, vals, seqs, pls = [], [], [], []
     for lo in range(0, p, chunk):
         hi = min(lo + chunk, p)
-        h, v, s, pl, _ = _get_probe(swarm, cfg, store, res.found[lo:hi],
-                                    keys[lo:hi])
+        h, v, s, pl, _ = _get_probe(swarm.alive, cfg, store, scfg,
+                                    res.found[lo:hi], keys[lo:hi])
         hits.append(h), vals.append(v), seqs.append(s), pls.append(pl)
     return GetResult(
         hit=jnp.concatenate(hits), val=jnp.concatenate(vals),
@@ -542,12 +634,13 @@ def get_values(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
 
 
 @partial(jax.jit, static_argnames=("cfg", "scfg"))
-def _listen_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+def _listen_insert(alive: jax.Array, cfg: SwarmConfig,
+                   store: SwarmStore,
                    scfg: StoreConfig, found: jax.Array, keys: jax.Array,
                    reg_ids: jax.Array) -> SwarmStore:
     ls = scfg.listen_slots
     p, q = found.shape
-    req_node = _mask_dead(swarm, cfg, found.reshape(-1))
+    req_node = _mask_dead_idx(alive, cfg, found.reshape(-1))
     req_key = jnp.repeat(keys, q, axis=0)
     req_id = jnp.repeat(reg_ids, q, axis=0)
     # Out-of-range registration ids are dropped outright — clipping
@@ -572,8 +665,8 @@ def _listen_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     slot = ((store.lcursor[n_safe] + rank.astype(jnp.uint32))
             % jnp.uint32(ls)).astype(jnp.int32)
     nn = jnp.where(accept, s_node, cfg.n_nodes)
-    lkeys = _pad1(store.lkeys).at[nn, slot].set(s_key)[:-1]
-    lids = _pad1(store.lids).at[nn, slot].set(s_id)[:-1]
+    lkeys = _key_write(store.lkeys, nn * ls + slot, s_key)
+    lids = store.lids.at[nn * ls + slot].set(s_id, mode="drop")
     n_new = jnp.zeros_like(store.lcursor).at[
         jnp.where(accept, s_node, 0)].add(accept.astype(jnp.uint32))
     return store._replace(lkeys=lkeys, lids=lids,
@@ -588,8 +681,8 @@ def listen_at(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     ``storageAddListener``).  Subsequent announces of a key flip the
     ``notified`` bit of its listeners."""
     res = lookup(swarm, cfg, keys, rng)
-    store = _listen_insert(swarm, cfg, store, scfg, res.found, keys,
-                           reg_ids)
+    store = _listen_insert(swarm.alive, cfg, store, scfg, res.found,
+                           keys, reg_ids)
     return store, res
 
 
@@ -622,20 +715,24 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     n_safe = jnp.clip(node_idx, 0, cfg.n_nodes - 1)
     ok = (node_idx >= 0)[:, None] & swarm.alive[n_safe][:, None] \
         & store.used[n_safe]                               # [M,S]
-    keys = store.keys[n_safe].reshape(-1, N_LIMBS)
     vals = store.vals[n_safe].reshape(-1)
     seqs = store.seqs[n_safe].reshape(-1)
     sizes = store.sizes[n_safe].reshape(-1)
     ttls = store.ttls[n_safe].reshape(-1)
-    # Explicit first dim: reshape(-1, 0) is ill-defined for the
-    # zero-width (token-only) payload array.
-    payloads = store.payload[n_safe].reshape(
-        node_idx.shape[0] * s, store.payload.shape[-1])
+    m_rows = node_idx.shape[0] * s
+    rows = (n_safe[:, None] * s
+            + jnp.arange(s, dtype=jnp.int32)[None, :]).reshape(-1)
+    keys = _key_rows(store.keys, rows)                   # [M·S, 5]
+    w = scfg.payload_words
+    if w:
+        payloads = _pl_gather(store.payload, rows, w)
+    else:
+        payloads = jnp.zeros((m_rows, 0), jnp.uint32)
     okf = ok.reshape(-1)
     res = lookup(swarm, cfg, keys, rng)
     found = jnp.where(okf[:, None], res.found, -1)
-    store, replicas = _announce_insert(swarm, cfg, store, scfg, found,
-                                       keys, vals, seqs,
+    store, replicas = _announce_insert(swarm.alive, cfg, store, scfg,
+                                       found, keys, vals, seqs,
                                        jnp.uint32(now), sizes, ttls,
                                        payloads)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,

@@ -120,6 +120,16 @@ def _bucketize(owner: jax.Array, ok: jax.Array, n_shards: int,
     return src, pos, sent
 
 
+def _fill_buckets(payload: jax.Array, src: jax.Array, n_shards: int,
+                  cap: int, fill) -> jax.Array:
+    """Build the ``[D, cap, W]`` shuffle buffer from ``_bucketize``'s
+    slot sources by whole-row gather (empty slots read ``fill``)."""
+    q = payload.shape[0]
+    srcf = jnp.clip(src.reshape(-1), 0, max(q - 1, 0))
+    return jnp.where((src >= 0).reshape(-1, 1), payload[srcf],
+                     fill).reshape(n_shards, cap, payload.shape[1])
+
+
 def _route_respond(tables_local: jax.Array, ids: jax.Array,
                    alive: jax.Array, targets: jax.Array, nid: jax.Array,
                    nid_d0: jax.Array, cfg: SwarmConfig, n_shards: int,
@@ -169,9 +179,7 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
     # path.  Buckets fill by sort + row gather (see ``_bucketize``).
     src, pos, sent = _bucketize(owner, ok, n_shards, cap)
     pay = jnp.stack([local_row, c0, c1], axis=-1)          # [Q,3]
-    srcf = jnp.clip(src.reshape(-1), 0, max(q - 1, 0))
-    qbuf = jnp.where((src >= 0).reshape(-1, 1), pay[srcf],
-                     -1).reshape(n_shards, cap, 3)
+    qbuf = _fill_buckets(pay, src, n_shards, cap, -1)
 
     a2a = partial(jax.lax.all_to_all, axis_name=AXIS, split_axis=0,
                   concat_axis=0, tiled=True)

@@ -41,7 +41,8 @@ from ..models.storage import (
     GetResult,
     StoreConfig,
     SwarmStore,
-    _pad1,
+    _key_match,
+    _key_write,
     _pick_payload,
     _segment_rank,
     _store_insert,
@@ -51,7 +52,7 @@ from ..models.storage import (
 from ..models.swarm import Swarm, SwarmConfig
 from ..ops.xor_metric import N_LIMBS
 from .mesh import AXIS
-from .sharded import sharded_lookup
+from .sharded import _bucketize, _fill_buckets, sharded_lookup
 
 
 def _u2i(x: jax.Array) -> jax.Array:
@@ -77,13 +78,8 @@ def _route_out(payload: jax.Array, owner: jax.Array, ok: jax.Array,
     fancy gathers run on the TPU's slow per-element paths).  Returns
     ``(rbuf [D,cap,W], pos, sent)``; dropped rows have ``sent``
     False."""
-    from .sharded import _bucketize
-
-    q = owner.shape[0]
     src, pos, sent = _bucketize(owner, ok, n_shards, cap)
-    srcf = jnp.clip(src.reshape(-1), 0, max(q - 1, 0))
-    qbuf = jnp.where((src >= 0).reshape(-1, 1), payload[srcf],
-                     -1).reshape(n_shards, cap, payload.shape[1])
+    qbuf = _fill_buckets(payload, src, n_shards, cap, -1)
     rbuf = jax.lax.all_to_all(qbuf, AXIS, split_axis=0, concat_axis=0,
                               tiled=True)
     return rbuf, pos, sent
@@ -101,8 +97,8 @@ def _route_back(resp: jax.Array, owner: jax.Array, pos: jax.Array,
     return jnp.where(sent[:, None], mine, -1)
 
 
-def _probe_refresh(store_local: SwarmStore, r_node, r_key, r_seq,
-                   r_val, now):
+def _probe_refresh(store_local: SwarmStore, scfg: StoreConfig,
+                   r_node, r_key, r_seq, r_val, now):
     """Owner-side announce probe + refresh (one exchange).
 
     The reference's two-phase announce probes ``SELECT id,seq`` at each
@@ -119,12 +115,12 @@ def _probe_refresh(store_local: SwarmStore, r_node, r_key, r_seq,
     equal-seq conflicting (skip: a full announce would be rejected by
     the edit policy anyway).
     """
-    rows = store_local.keys.shape[0]
+    rows = store_local.used.shape[0]
+    s = scfg.slots
     n_safe = jnp.clip(r_node, 0, rows - 1)
     valid = r_node >= 0
-    sk = store_local.keys[n_safe]                        # [M,S,5]
     km = store_local.used[n_safe] \
-        & jnp.all(sk == r_key[:, None, :], axis=-1)      # [M,S]
+        & _key_match(store_local.keys, n_safe, s, r_key)  # [M,S]
     has = jnp.any(km, axis=-1)
     mslot = jnp.argmax(km, axis=-1).astype(jnp.int32)
     cur_seq = store_local.seqs[n_safe, mslot]
@@ -135,10 +131,11 @@ def _probe_refresh(store_local: SwarmStore, r_node, r_key, r_seq,
                        jnp.where(need_full, 0, 2))
     status = jnp.where(valid, status, -1)
     # Refresh: reset the matching slot's age (duplicate probes of the
-    # same slot all write the same ``now`` — scatter-max is safe).
+    # same slot all write the same ``now`` — scatter-max is safe;
+    # masked rows go out of bounds and drop).
     un = jnp.where(fresh_same, n_safe, rows)
-    created = _pad1(store_local.created).at[un, mslot].max(
-        jnp.uint32(now))[:-1]
+    created = store_local.created.at[un, mslot].max(
+        jnp.uint32(now), mode="drop")
     return status, store_local._replace(created=created)
 
 
@@ -175,7 +172,7 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     owner = jnp.clip(safe // shard_n, 0, n_shards - 1).astype(jnp.int32)
     local_row = jnp.where(ok, safe - owner * shard_n, -1)
 
-    w = store_local.payload.shape[-1]
+    w = scfg.payload_words
     rep = lambda a: jnp.repeat(a, quorum, axis=0)
     refreshed = jnp.zeros((q,), bool)
     if probe:
@@ -189,8 +186,8 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
         p_key = _i2u(rbuf[..., 1:1 + N_LIMBS]).reshape(-1, N_LIMBS)
         p_seq = _i2u(rbuf[..., 1 + N_LIMBS]).reshape(-1)
         p_val = _i2u(rbuf[..., 2 + N_LIMBS]).reshape(-1)
-        status, store_local = _probe_refresh(store_local, p_node, p_key,
-                                             p_seq, p_val, now)
+        status, store_local = _probe_refresh(store_local, scfg, p_node,
+                                             p_key, p_seq, p_val, now)
         back = _route_back(status.reshape(n_shards, cap1, 1), owner,
                            pos1, sent1, cap1)
         st = back[:, 0]
@@ -314,22 +311,30 @@ def _probe_phase_body(cfg: SwarmConfig, scfg: StoreConfig,
 
     r_node = rbuf[..., 0].reshape(-1)
     r_key = _i2u(rbuf[..., 1:]).reshape(-1, N_LIMBS)
-    shard_rows = store_local.keys.shape[0]
+    shard_rows = store_local.used.shape[0]
     n_safe = jnp.clip(r_node, 0, shard_rows - 1)
     valid = r_node >= 0
-    sk = store_local.keys[n_safe]                        # [M,S,5]
     hit = store_local.used[n_safe] & valid[:, None] \
-        & jnp.all(sk == r_key[:, None, :], axis=-1)      # [M,S]
+        & _key_match(store_local.keys, n_safe, scfg.slots, r_key)
     seq = jnp.where(hit, store_local.seqs[n_safe], 0)
     best = jnp.max(seq, axis=1)
     is_b = hit & (seq == best[:, None])
     val = jnp.max(jnp.where(is_b, store_local.vals[n_safe], 0), axis=1)
     anyhit = jnp.any(hit, axis=1)
-    w = store_local.payload.shape[-1]
+    w = scfg.payload_words
     # Bytes of ONE winning replica ride back with the (hit, val, seq)
-    # triple (no-blend single pick — see _pick_payload).
+    # triple — flat per-column fetch, no small-minor gather on a big
+    # payload operand (see models.storage._pl_gather).
     is_w = is_b & (store_local.vals[n_safe] == val[:, None])  # [M,S]
-    pl = _pick_payload(is_w, store_local.payload[n_safe], anyhit)
+    sslots = scfg.slots
+    wslot = jnp.argmax(is_w, axis=1).astype(jnp.int32)
+    if w:
+        from ..models.storage import _pl_gather
+        pl = jnp.where(anyhit[:, None],
+                       _pl_gather(store_local.payload,
+                                  n_safe * sslots + wslot, w), 0)
+    else:
+        pl = jnp.zeros((is_w.shape[0], 0), jnp.uint32)
 
     resp = jnp.concatenate(
         [jnp.stack([anyhit.astype(jnp.int32), _u2i(val), _u2i(best)],
@@ -357,11 +362,11 @@ def _store_specs(mesh: Mesh) -> SwarmStore:
     ``notified`` table replicated."""
     shd = P(AXIS)
     return SwarmStore(
-        keys=P(AXIS, None, None), vals=P(AXIS, None), seqs=P(AXIS, None),
+        keys=P(AXIS), vals=P(AXIS, None), seqs=P(AXIS, None),
         created=P(AXIS, None), used=P(AXIS, None), cursor=shd,
-        lkeys=P(AXIS, None, None), lids=P(AXIS, None), lcursor=shd,
+        lkeys=P(AXIS), lids=P(AXIS), lcursor=shd,
         notified=P(), sizes=P(AXIS, None), ttls=P(AXIS, None),
-        payload=P(AXIS, None, None), nseqs=P(), nvals=P(),
+        payload=P(AXIS), nseqs=P(), nvals=P(),
         npayload=P(None, None))
 
 
@@ -529,7 +534,9 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     reps, hops, done = [], [], []
     for i, nlo in enumerate(range(0, n, cn)):
         nsl = slice(nlo, nlo + cn)
-        keys = store.keys[nsl].reshape(cn * s, N_LIMBS)
+        keys = store.keys[nlo * s * N_LIMBS:
+                          (nlo + cn) * s * N_LIMBS].reshape(cn * s,
+                                                            N_LIMBS)
         # Dead/empty source slots announce to no one (the republisher
         # is the node OWNING the slot, so its aliveness gates the row).
         okf = (swarm.alive[nsl, None] & store.used[nsl]).reshape(-1)
@@ -541,7 +548,10 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
             swarm, cfg, store, scfg, found, keys,
             store.vals[nsl].reshape(-1), store.seqs[nsl].reshape(-1),
             store.sizes[nsl].reshape(-1), store.ttls[nsl].reshape(-1),
-            store.payload[nsl].reshape(cn * s, -1), now, mesh,
+            store.payload[nlo * s * scfg.payload_words:
+                          (nlo + cn) * s * scfg.payload_words
+                          ].reshape(cn * s, scfg.payload_words),
+            now, mesh,
             capacity_factor, probe, full_capacity_factor)
         reps.append(replicas), hops.append(res.hops), done.append(res.done)
     return store, AnnounceReport(replicas=jnp.concatenate(reps),
@@ -604,13 +614,13 @@ def _listen_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     live = s_node >= 0
     rank = _segment_rank(s_node_sk, live)
     accept = live & (rank < ls)
-    rows = store_local.lkeys.shape[0]
+    rows = store_local.used.shape[0]
     n_safe = jnp.clip(s_node, 0, rows - 1)
     slot = ((store_local.lcursor[n_safe] + rank.astype(jnp.uint32))
             % jnp.uint32(ls)).astype(jnp.int32)
     nn = jnp.where(accept, s_node, rows)
-    lkeys = _pad1(store_local.lkeys).at[nn, slot].set(s_key)[:-1]
-    lids = _pad1(store_local.lids).at[nn, slot].set(s_id)[:-1]
+    lkeys = _key_write(store_local.lkeys, nn * ls + slot, s_key)
+    lids = store_local.lids.at[nn * ls + slot].set(s_id, mode="drop")
     n_new = jnp.zeros_like(store_local.lcursor).at[
         jnp.where(accept, s_node, 0)].add(accept.astype(jnp.uint32))
     store_local = store_local._replace(

@@ -360,7 +360,8 @@ def test_storage_seq_semantics_device():
         # policy claims as the host leg (check_replica_outcomes), plus
         # the get must return one of the freshest replicas' tags.
         m = np.asarray(store.used) \
-            & (np.asarray(store.keys) == kn).all(-1)
+            & (np.asarray(store.keys).reshape(cfg.n_nodes, -1, 5)
+               == kn).all(-1)
         pairs = set(zip(np.asarray(store.seqs)[m].tolist(),
                         np.asarray(store.vals)[m].tolist()))
         assert pairs, f"step {step}: no replica stored"

@@ -56,8 +56,9 @@ class TestStoreInsert:
         assert used.sum() == 3
         r = np.asarray(reps)[:4]
         assert r.tolist() == [1, 1, 1, 0]
-        # Stored key/val round-trip.
-        k3 = np.asarray(store.keys[3])[np.asarray(store.used[3])]
+        # Stored key/val round-trip (keys are stored flat [N*S*5]).
+        keys3 = np.asarray(store.keys).reshape(64, SCFG.slots, 5)
+        k3 = keys3[3][np.asarray(store.used[3])]
         assert {tuple(row) for row in k3} == {
             tuple(np.asarray(key[0])), tuple(np.asarray(key[2]))}
 
