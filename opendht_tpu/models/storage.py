@@ -107,17 +107,15 @@ def _key_match(flat_keys: jax.Array, node: jax.Array, n_slots: int,
 
 def _key_rows(flat_keys: jax.Array, row: jax.Array) -> jax.Array:
     """Gather whole 5-limb keys ``[..., 5]`` by slot-row index from the
-    flat key store (one element gather, see :func:`_key_match`)."""
-    idx = row[..., None] * N_LIMBS + jnp.arange(N_LIMBS, dtype=jnp.int32)
-    return flat_keys[idx]
+    flat key store (one element gather; dtype-generic _pl_gather)."""
+    return _pl_gather(flat_keys, row, N_LIMBS)
 
 
 def _key_write(flat_keys: jax.Array, row: jax.Array,
                key: jax.Array) -> jax.Array:
     """Scatter 5-limb keys by slot-row index, one element scatter
-    (OOB rows drop)."""
-    idx = row[..., None] * N_LIMBS + jnp.arange(N_LIMBS, dtype=jnp.int32)
-    return flat_keys.at[idx].set(key, mode="drop")
+    (OOB rows drop; dtype-generic _pl_scatter)."""
+    return _pl_scatter(flat_keys, row, key, N_LIMBS)
 
 
 def _mask_dead_idx(alive: jax.Array, cfg: SwarmConfig,

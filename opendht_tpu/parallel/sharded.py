@@ -31,7 +31,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.swarm import (
+    LOOKUP_HEADROOM_BYTES,
     LookupResult,
+    LookupState,
     Swarm,
     SwarmConfig,
     _finalize,
@@ -42,9 +44,12 @@ from ..models.swarm import (
     _select_alpha,
     _select_pair_window,
     _unpack_pair_window,
+    device_hbm_bytes,
     init_impl,
     lookup,
+    run_burst_loop,
     step_impl,
+    table_bytes,
 )
 from ..ops.xor_metric import prefix_len32
 from .mesh import AXIS
@@ -281,8 +286,6 @@ def _sharded_body(cfg: SwarmConfig, n_shards: int,
     ll = targets.shape[0]
     me = jax.lax.axis_index(AXIS)
     key = jax.random.fold_in(key, me)
-
-    from ..models.swarm import _sample_origins
     origins = _sample_origins(key, alive, ll)
 
     respond_init, respond = _make_responders(
@@ -357,7 +360,6 @@ def _make_respond_body(cfg, n_shards, capacity_factor, local_respond,
 
 
 def _st_specs():
-    from ..models.swarm import LookupState
     return LookupState(targets=P(AXIS, None), idx=P(AXIS, None),
                        dist=P(AXIS, None), queried=P(AXIS, None),
                        done=P(AXIS), hops=P(AXIS))
@@ -392,7 +394,6 @@ def _sharded_lookup_step(swarm, cfg, st, mesh, capacity_factor,
 
 
 def _table_bytes_per_device(cfg: SwarmConfig, n_shards: int) -> int:
-    from ..models.swarm import table_bytes
     return table_bytes(cfg) // max(1, n_shards)
 
 
@@ -416,13 +417,11 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     how the 10M-node table runs on a 16 GB chip, where the while
     formulation is a measured OOM).
     """
-    from ..models.swarm import LOOKUP_HEADROOM_BYTES, device_hbm_bytes
     n_shards = mesh.shape[AXIS]
     if (2 * _table_bytes_per_device(cfg, n_shards)
             + LOOKUP_HEADROOM_BYTES <= device_hbm_bytes()):
         return _sharded_lookup_while(swarm, cfg, targets, key, mesh,
                                      capacity_factor, local_respond)
-    from ..models.swarm import run_burst_loop
     st = _sharded_lookup_init(swarm, cfg, targets, key, mesh,
                               capacity_factor, local_respond)
     st = run_burst_loop(
