@@ -678,13 +678,15 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     active = ~st.done & jnp.any(sel >= 0, axis=1)
     done = st.done | _sync_done(f_idx, f_q, cfg) | ~jnp.any(
         (f_idx >= 0) & ~f_q, axis=1)
+    # No done-freeze copies: a done lookup solicits nobody (sel = -1),
+    # so its merge inputs are its own shortlist plus invalid slots, and
+    # the two-pass stable merge is idempotent on an already-merged
+    # state (equal-d0 ties order by node index from pass 1, independent
+    # of input order) — f_* already equal st.* bit-for-bit for done
+    # rows.  The wheres cost three [L,S] copies per round.
     return LookupState(
-        targets=st.targets,
-        idx=jnp.where(st.done[:, None], st.idx, f_idx),
-        dist=jnp.where(st.done[:, None], st.dist, f_dist),
-        queried=jnp.where(st.done[:, None], st.queried, f_q),
-        done=done,
-        hops=st.hops + active.astype(jnp.int32))
+        targets=st.targets, idx=f_idx, dist=f_dist, queried=f_q,
+        done=done, hops=st.hops + active.astype(jnp.int32))
 
 
 def _resp_dist(ids: jax.Array, cfg: SwarmConfig, targets: jax.Array,

@@ -44,6 +44,7 @@ from ..models.storage import (
     _key_match,
     _key_write,
     _pick_payload,
+    _pl_gather,
     _segment_rank,
     _store_insert,
     empty_store,
@@ -329,7 +330,6 @@ def _probe_phase_body(cfg: SwarmConfig, scfg: StoreConfig,
     sslots = scfg.slots
     wslot = jnp.argmax(is_w, axis=1).astype(jnp.int32)
     if w:
-        from ..models.storage import _pl_gather
         pl = jnp.where(anyhit[:, None],
                        _pl_gather(store_local.payload,
                                   n_safe * sslots + wslot, w), 0)
