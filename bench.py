@@ -58,6 +58,14 @@ def even_chunk_size(total: int, target: int, multiple: int = 1) -> int:
 
 
 def main():
+    # Initialize the backend FIRST: config construction must never
+    # touch the backend itself (dryrun invariant), and without a live
+    # backend the HBM-derived cutoffs (and the --aug help text below)
+    # would read the conservative 16 GB-class fallback instead of this
+    # device's memory_stats().
+    jax.devices()
+    from opendht_tpu.models.swarm import _aug_table_budget
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=None,
                     help="swarm size (default: 1M; churn mode: 100k)")
@@ -67,8 +75,11 @@ def main():
     ap.add_argument("--aug", choices=("auto", "on", "off"),
                     default="auto",
                     help="augmented tables (auto: on while the "
-                         "[N,B,3K] u16 table fits ~11.5 GB — "
-                         "includes the 10M-node north star)")
+                         "[N,B,3K] u16 table fits the budget derived "
+                         "from this device's memory_stats() — "
+                         f"~{_aug_table_budget() / 1e9:.1f} GB here, "
+                         "lookup headroom already subtracted; includes "
+                         "the 10M-node north star on a 16 GB chip)")
     ap.add_argument("--lookup-batch", type=int, default=0,
                     help="split lookups into device batches of this "
                          "size (0 = single batch); lets big-N swarms "
@@ -77,10 +88,13 @@ def main():
     ap.add_argument("--recall-sample", type=int, default=512)
     ap.add_argument("--mode",
                     choices=("lookups", "putget", "churn", "crawl",
-                             "sharded", "hotshard", "repub"),
+                             "sharded", "hotshard", "repub", "chaos"),
                     default="lookups")
     ap.add_argument("--kill-frac", type=float, default=0.5,
-                    help="fraction of nodes killed in --mode churn")
+                    help="fraction of nodes killed in --mode churn/chaos")
+    ap.add_argument("--drop-frac", type=float, default=0.15,
+                    help="chaos mode: fraction of announce/probe "
+                         "exchanges lost per maintenance sweep")
     ap.add_argument("--zipf", type=float, default=0.0,
                     help="churn mode: draw gets Zipf(s)-skewed over "
                          "the put keyset (0 = uniform, one get/key); "
@@ -115,12 +129,8 @@ def main():
     if args.nodes is None:
         args.nodes = {"churn": 100_000, "sharded": 1_000_000,
                       "hotshard": 1_000_000,
-                      "repub": 65_536}.get(args.mode, 10_000_000)
-    # Initialize the backend before any SwarmConfig exists: config
-    # construction itself must never touch the backend (dryrun
-    # invariant), so without this the HBM-derived cutoffs would size
-    # against the conservative fallback instead of memory_stats().
-    jax.devices()
+                      "repub": 65_536,
+                      "chaos": 65_536}.get(args.mode, 10_000_000)
     if args.mode == "putget":
         return putget_main(args)
     if args.mode == "churn":
@@ -133,6 +143,8 @@ def main():
         return hotshard_main(args)
     if args.mode == "repub":
         return repub_main(args)
+    if args.mode == "chaos":
+        return chaos_main(args)
 
     from opendht_tpu.models.swarm import (
         SwarmConfig, build_swarm, lookup, true_closest,
@@ -871,14 +883,177 @@ def repub_main(args):
         "steady_reduction": round(1 - ws_probe / ws_full, 4),
         "republish_wall_s_full": round(t_full, 3),
         "republish_wall_s_probe": round(t_probe, 3),
-        # The probe phase costs a flat 9 words/slot; it pays off iff
-        # the full-phase shrink saves more: (cf−fcf)·(11+W) > cf·9.
-        # At small payloads the reduction is legitimately NEGATIVE —
-        # that is the measured break-even, not a regression.  None =
-        # fcf saturated to cf (heavy churn): probing never pays.
+        # The probe phase costs a flat 10 words/slot (incl. the payload
+        # digest); it pays off iff the full-phase shrink saves more:
+        # (cf−fcf)·(11+W) > cf·10.  At small payloads the reduction is
+        # legitimately NEGATIVE — that is the measured break-even, not
+        # a regression.  None = fcf saturated to cf (heavy churn):
+        # probing never pays.
         "probe_breakeven_payload_words": (
-            max(0, math.ceil(9 * cf / (cf - fcf_churn)) - 11)
+            max(0, math.ceil(10 * cf / (cf - fcf_churn)) - 11)
             if cf > fcf_churn else None),
+        "sim_fidelity": "payload-chunks",
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+
+
+def chaos_main(args):
+    """Chaos-survival: the storage/pub-sub path under COMBINED fault
+    injection — mass node death injected MID-maintenance, a fraction
+    of every announce/probe exchange dropped, and the full listener
+    lifecycle (TTL'd registrations, acks between changes, cancels)
+    running through it.  The storage twin of the lookup path's churn
+    bench: Kademlia's whole point is serving through massive failure
+    (arXiv:1309.5866), and this leg is the measurement that the
+    storage half degrades gracefully rather than corrupting.
+
+    One JSON row: survival (primary), value/payload integrity, and a
+    listener-continuity block — first delivery, post-chaos redelivery,
+    a SECOND value change observed after an ack, and the canceled-
+    listener leak rate (must be 0).
+    """
+    from opendht_tpu.models.storage import StoreConfig
+    from opendht_tpu.models.swarm import (
+        SwarmConfig, build_swarm, churn, heal_swarm,
+    )
+    from opendht_tpu.parallel import make_mesh
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_ack_listeners, sharded_announce, sharded_cancel_listen,
+        sharded_empty_store, sharded_get, sharded_listen_at,
+        sharded_republish,
+    )
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev)
+    cfg = SwarmConfig.for_nodes(args.nodes)
+    w = args.payload_words or 8
+    scfg = StoreConfig(slots=args.slots or 4, listen_slots=4,
+                       max_listeners=1 << 12, payload_words=w,
+                       listen_ttl=1_000)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    _ = np.asarray(swarm.tables[:1, :1])
+    # Mesh-divisible batch sizes, puts bounded under store capacity
+    # (an overfull ring store would measure eviction, not survival).
+    p = max(n_dev,
+            min(args.puts, cfg.n_nodes * scfg.slots // 16)
+            // n_dev * n_dev)
+    nl = max(n_dev, min(p, 2048) // n_dev * n_dev)   # listener subset
+    keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+    payloads = jax.random.bits(jax.random.PRNGKey(8), (p, w), jnp.uint32)
+    cf = 4.0
+    kf, drop = args.kill_frac, args.drop_frac
+    regs = jnp.arange(nl, dtype=jnp.int32)
+
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    store, ldone = sharded_listen_at(swarm, cfg, store, scfg, keys[:nl],
+                                     regs, jax.random.PRNGKey(2), mesh,
+                                     capacity_factor=cf, now=0)
+    store, rep = sharded_announce(swarm, cfg, store, scfg, keys, vals,
+                                  seqs, 1, jax.random.PRNGKey(3), mesh,
+                                  capacity_factor=cf, payloads=payloads)
+    pre_replicas = float(np.asarray(rep.replicas).mean())
+    first_rate = float(np.asarray(store.notified)[:nl].mean())
+    store = sharded_ack_listeners(store, regs)
+
+    # --- the chaos cycle: kill kill_frac MID-republish + exchange loss
+    half = cfg.n_nodes // 2 // n_dev * n_dev
+    dead = swarm
+    t0 = time.perf_counter()
+    store, _ = sharded_republish(dead, cfg, store, scfg, 2,
+                                 jax.random.PRNGKey(4), mesh,
+                                 capacity_factor=cf,
+                                 node_range=(0, half), drop_frac=drop,
+                                 drop_key=jax.random.PRNGKey(5))
+    dead = churn(dead, jax.random.PRNGKey(6), kf, cfg)
+    store, _ = sharded_republish(dead, cfg, store, scfg, 3,
+                                 jax.random.PRNGKey(7), mesh,
+                                 capacity_factor=cf,
+                                 node_range=(half, cfg.n_nodes),
+                                 drop_frac=drop,
+                                 drop_key=jax.random.PRNGKey(9))
+    # Bucket maintenance after the mass death (heal_swarm): the
+    # survival metric must measure STORAGE degradation, not stale-
+    # routing-table lookup starvation — the second half-sweep above
+    # deliberately still ran on corpse-laden tables (mid-chaos), the
+    # healing sweep and the measurement gets below run on healed ones.
+    dead = heal_swarm(dead, cfg, jax.random.PRNGKey(16))
+    # Healing sweep by the survivors — the probed maintenance shape
+    # (full-value phase provisioned to the churn-displaced fraction),
+    # still under exchange loss.
+    store, hrep = sharded_republish(dead, cfg, store, scfg, 4,
+                                    jax.random.PRNGKey(10), mesh,
+                                    capacity_factor=cf, probe=True,
+                                    full_capacity_factor=min(
+                                        cf, 2 * kf + 0.2),
+                                    drop_frac=drop,
+                                    drop_key=jax.random.PRNGKey(11))
+    _ = int(np.asarray(jnp.sum(hrep.replicas[:8])))
+    chaos_s = time.perf_counter() - t0
+
+    res = sharded_get(dead, cfg, store, scfg, keys,
+                      jax.random.PRNGKey(12), mesh, capacity_factor=cf)
+    hit = np.asarray(res.hit)
+    survival = float(hit.mean())
+    vals_ok = bool(np.asarray(
+        jnp.where(res.hit, res.val == vals, True)).all())
+    pl_ok = bool((np.asarray(res.payload)[hit]
+                  == np.asarray(payloads)[hit]).all())
+    # Maintenance re-announces listened-for keys → post-ack redelivery.
+    redeliver_rate = float(np.asarray(store.notified)[:nl].mean())
+
+    # --- listener continuity: a SECOND value change after an ack
+    store = sharded_ack_listeners(store, regs)
+    vals2 = vals + 1_000_000
+    pls2 = jax.random.bits(jax.random.PRNGKey(13), (nl, w), jnp.uint32)
+    store, _ = sharded_announce(dead, cfg, store, scfg, keys[:nl],
+                                vals2[:nl], seqs[:nl] + 1, 5,
+                                jax.random.PRNGKey(14), mesh,
+                                capacity_factor=cf, payloads=pls2)
+    n2 = np.asarray(store.notified)[:nl]
+    second_ok = n2 & (np.asarray(store.nvals)[:nl] == np.asarray(
+        vals2[:nl]))
+    second_rate = float(second_ok.mean())
+
+    # --- cancel half, third change must NOT leak to canceled ids
+    store = sharded_cancel_listen(store, scfg, regs[:nl // 2])
+    store = sharded_ack_listeners(store, regs)
+    store, _ = sharded_announce(dead, cfg, store, scfg, keys[:nl],
+                                vals2[:nl] + 1, seqs[:nl] + 2, 6,
+                                jax.random.PRNGKey(15), mesh,
+                                capacity_factor=cf)
+    n3 = np.asarray(store.notified)[:nl]
+    canceled_leak = float(n3[:nl // 2].mean())
+    active_third_rate = float(n3[nl // 2:].mean())
+
+    out = {
+        "metric": "swarm_chaos_survival_rate",
+        "value": round(survival, 4),
+        "unit": "fraction",
+        # Same baseline as churn mode: the host-path persistence
+        # scenario re-found 7/8 after killing all hosting nodes
+        # (BASELINE.md).
+        "vs_baseline": round(survival / (7 / 8), 3),
+        "n_nodes": cfg.n_nodes,
+        "n_puts": p,
+        "slots": scfg.slots,
+        "payload_bytes": 4 * w,
+        "kill_frac": kf,
+        "drop_frac": drop,
+        "mid_republish_kill": True,
+        "alive_frac_final": float(np.asarray(dead.alive).mean()),
+        "mean_replicas_before": round(pre_replicas, 2),
+        "chaos_wall_s": round(chaos_s, 3),
+        "values_intact": vals_ok,
+        "payloads_intact": pl_ok,
+        "listeners": nl,
+        "listen_first_delivery_rate": round(first_rate, 4),
+        "listen_redelivery_rate": round(redeliver_rate, 4),
+        "listen_second_change_rate": round(second_rate, 4),
+        "listen_canceled_leak_rate": round(canceled_leak, 4),
+        "listen_active_third_rate": round(active_third_rate, 4),
         "sim_fidelity": "payload-chunks",
         "platform": jax.devices()[0].platform,
     }

@@ -26,18 +26,46 @@ from .utils.sockaddr import SockAddr  # noqa: F401
 from .core.value import Value, ValueType, Query, Select, Where  # noqa: F401
 from .core.node import Node  # noqa: F401
 from .core.dht import Dht, DhtConfig  # noqa: F401
-from .crypto.identity import (  # noqa: F401
-    Certificate,
-    Identity,
-    PrivateKey,
-    PublicKey,
-    generate_identity,
-)
-from .crypto.securedht import SecureDht, SecureDhtConfig  # noqa: F401
-from .runtime.dhtrunner import DhtRunner, DhtRunnerConfig  # noqa: F401
-from .runtime.nodeset import NodeSet  # noqa: F401
-from .indexation.pht import Pht  # noqa: F401
-from .harness.network import DhtNetwork  # noqa: F401
+# The crypto layer (and the runner built on it) needs the optional
+# ``cryptography``/``argon2-cffi`` wheels.  Containers without them
+# must still import the package — the host core, the harness and the
+# whole device engine are crypto-free — so these imports are GATED:
+# missing deps degrade to a loud, attribute-level ImportError instead
+# of poisoning ``import opendht_tpu`` for every consumer.
+_CRYPTO_IMPORT_ERROR: ImportError | None = None
+try:
+    from .crypto.identity import (  # noqa: F401
+        Certificate,
+        Identity,
+        PrivateKey,
+        PublicKey,
+        generate_identity,
+    )
+    from .crypto.securedht import SecureDht, SecureDhtConfig  # noqa: F401
+    from .runtime.dhtrunner import DhtRunner, DhtRunnerConfig  # noqa: F401
+except ImportError as _e:  # pragma: no cover — dep-less containers
+    _CRYPTO_IMPORT_ERROR = _e
+
+_CRYPTO_NAMES = frozenset({
+    "Certificate", "Identity", "PrivateKey", "PublicKey",
+    "generate_identity", "SecureDht", "SecureDhtConfig",
+    "DhtRunner", "DhtRunnerConfig",
+})
+
+
+def __getattr__(name: str):
+    if name in _CRYPTO_NAMES and _CRYPTO_IMPORT_ERROR is not None:
+        raise ImportError(
+            f"opendht_tpu.{name} requires the optional crypto "
+            f"dependencies (cryptography, argon2-cffi): "
+            f"{_CRYPTO_IMPORT_ERROR}")
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+from .runtime.nodeset import NodeSet  # noqa: F401,E402
+from .indexation.pht import Pht  # noqa: F401,E402
+from .harness.network import DhtNetwork  # noqa: F401,E402
 
 # The TPU swarm engine (jax-heavy) is intentionally NOT imported here;
 # use ``from opendht_tpu.models import SwarmConfig, build_swarm, lookup``

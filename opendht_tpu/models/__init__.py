@@ -7,6 +7,7 @@ from .swarm import (  # noqa: F401
     SwarmConfig,
     build_swarm,
     churn,
+    heal_swarm,
     lookup,
     lookup_init,
     lookup_recall,

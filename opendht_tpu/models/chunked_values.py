@@ -79,6 +79,12 @@ def announce_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     on the same quorum replicas.  The report's ``replicas`` counts
     replicas that accepted part 0 (the part whose size carries the
     value length).
+
+    Zero-length values round-trip: the reference permits empty value
+    data (value.h:73 caps only the maximum), so part 0 is stored for
+    EVERY valid announce row — a length-0 value occupies one slot with
+    recorded size 0 and reads back as a hit with empty payload, not as
+    a silent un-announce (ADVICE round 5).
     """
     p, parts, w = payloads.shape
     assert w == scfg.payload_words, (w, scfg.payload_words)
@@ -90,9 +96,11 @@ def announce_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     words = -(-lengths.astype(jnp.int32) // 4)               # [P]
     rep0 = None
     for j in range(parts):
-        active = words > j * w
+        # Part 0 is active unconditionally (it carries the value's
+        # existence and true length — including length 0).
+        active = (words > j * w) | (j == 0)
         found_j = jnp.where(active[:, None], res.found, -1)
-        sizes_j = (jnp.maximum(lengths, 1).astype(jnp.uint32) if j == 0
+        sizes_j = (lengths.astype(jnp.uint32) if j == 0
                    else jnp.ones_like(lengths, jnp.uint32))
         store, rep = _announce_insert(
             swarm.alive, cfg, store, scfg, found_j, part_key(keys, j),

@@ -24,7 +24,26 @@ storage RPCs become batched scatters/gathers:
   (/root/reference/src/dht.cpp:2186-2225,2299-2322): listener
   registrations live in a per-node table; every accepted announce
   matches against the target node's listeners and flips their
-  "notified" bits (the ``tellListener`` push).
+  "notified" bits (the ``tellListener`` push).  Registrations carry an
+  expiry (``StoreConfig.listen_ttl``) refreshed by
+  :func:`refresh_listeners` — the reference re-registers listeners
+  every 30 s and expires silent ones — and are cancelable mesh-wide
+  (:func:`cancel_listen`, the reference's ``Dht::cancelListen``,
+  include/opendht/dht.h:341-351).  Delivery slots are CONSUMABLE: a
+  reader ack (:func:`ack_listeners`) resets ``notified``/``nseqs`` so
+  the next accepted announce re-delivers — a listener observes the
+  second and third change, not just the first.
+
+  Deliberate simplification vs the reference: ``tellListener`` ships
+  the node's whole changed-VALUE LIST; these delivery slots hold only
+  the freshest single value per listener (highest seq wins).  A
+  listener over a key with several live values sees the newest one per
+  push — sufficient for the pub/sub scenarios the engine models, and
+  what keeps the per-listener state O(1) at 10M nodes.  Consequence of
+  consumable slots: after an ack, a re-announce at the SAME seq (or a
+  genuinely stale replica's republish) re-fires delivery — the
+  reference behaves the same way (every storageChanged pushes; clients
+  dedup by value id).
 * ``expire`` — per-value TTL sweep (``Storage::expire``,
   /root/reference/src/dht.cpp:2361-2381).
 * ``republish_from`` — per-node value maintenance: chosen nodes
@@ -82,6 +101,28 @@ def _pl_scatter(flat1: jax.Array, row: jax.Array, vals: jax.Array,
     drop."""
     idx = row[..., None] * w + jnp.arange(w, dtype=jnp.int32)
     return flat1.at[idx].set(vals, mode="drop")
+
+
+def _payload_digest(pl: jax.Array) -> jax.Array:
+    """Order-sensitive 32-bit digest of payload rows ``[..., W]``.
+
+    One word per value on the probe wire stands in for W words of
+    bytes: ``sum_j pl[..., j] · C^(j+1) (mod 2³²)`` with odd constant
+    C (invertible mod 2³²), so a word swap or single-word change moves
+    the digest — cheap (one fused multiply-sum), not cryptographic.
+    Used by the announce probe to match the edit policy's "data
+    exactly the same" test without shipping the payload
+    (:func:`opendht_tpu.parallel.sharded_storage._probe_refresh`).
+    """
+    w = pl.shape[-1]
+    if w == 0:
+        return jnp.zeros(pl.shape[:-1], jnp.uint32)
+    c, x, pows = 0x9E3779B1, 1, []
+    for _ in range(w):
+        x = (x * c) & 0xFFFFFFFF
+        pows.append(x)
+    return jnp.sum(pl * jnp.asarray(pows, jnp.uint32), axis=-1,
+                   dtype=jnp.uint32)
 
 
 def _key_match(flat_keys: jax.Array, node: jax.Array, n_slots: int,
@@ -149,6 +190,12 @@ class StoreConfig(NamedTuple):
     reference's value data (64 KB cap, value.h:73) at a fixed chunk
     width; 0 (default) keeps the token-only store, flagged as
     ``sim_fidelity: "token-values"`` in bench artifacts.
+
+    ``listen_ttl`` is the listener-registration lifetime in sim-time
+    units (0 = registrations never expire): the reference registers
+    listeners WITH expiration and re-registers every ~30 s
+    (/root/reference/src/dht.cpp:2299-2322); :func:`refresh_listeners`
+    is that re-register sweep, :func:`expire_listeners` the reclaim.
     """
     slots: int = 16
     listen_slots: int = 4
@@ -156,6 +203,7 @@ class StoreConfig(NamedTuple):
     max_listeners: int = 1 << 16
     budget: int = 0
     payload_words: int = 0
+    listen_ttl: int = 0
 
 
 class SwarmStore(NamedTuple):
@@ -171,6 +219,7 @@ class SwarmStore(NamedTuple):
     cursor: jax.Array    # [N] uint32     — ring write position
     lkeys: jax.Array     # [N*LS*5] uint32 — listened-for keys (flat)
     lids: jax.Array      # [N*LS] int32 — listener registration id, -1 (flat)
+    lexps: jax.Array     # [N*LS] uint32 — listener expiry time (0 = never)
     lcursor: jax.Array   # [N] uint32
     notified: jax.Array  # [max_listeners] bool — listener got a push
     sizes: jax.Array     # [N,S] uint32   — stored value sizes
@@ -208,8 +257,46 @@ class GetResult(NamedTuple):
     payload: jax.Array = None  # [P,W] uint32 — bytes (None/W=0: tokens)
 
 
+def validate_store_geometry(n_nodes: int, scfg: StoreConfig) -> None:
+    """Reject store geometries whose FLAT element indices overflow
+    int32 — a bad config must fail loudly at construction, not wrap
+    indices and silently drop writes.
+
+    Every payload/key op computes ``row·width + col`` in int32 with
+    rows up to ``(n_nodes+1)·slots`` (masked requests scatter to the
+    out-of-bounds node ``n_nodes`` and rely on ``mode="drop"`` — a
+    WRAPPED negative index is in-bounds again and corrupts live data).
+    Before this check, ``bench.py --mode repub --nodes 10000000`` with
+    default slots=4 / payload_words=64 (2.56e9 elements > 2³¹) wrapped
+    exactly that way (ADVICE round 5, medium).
+    """
+    lim = 2 ** 31
+    rows = (n_nodes + 1) * scfg.slots
+    lrows = (n_nodes + 1) * scfg.listen_slots
+    checks = (
+        ("keys", rows * N_LIMBS),
+        ("payload", rows * scfg.payload_words),
+        ("listener keys", lrows * N_LIMBS),
+        ("listener ids", lrows),
+        ("listener table", scfg.max_listeners),
+    )
+    for name, n_elems in checks:
+        if n_elems >= lim:
+            raise ValueError(
+                f"StoreConfig overflows int32 flat indexing: the {name} "
+                f"store needs {n_elems:,} elements "
+                f"(≥ 2^31 = {lim:,}) at n_nodes={n_nodes:,}, "
+                f"slots={scfg.slots}, listen_slots={scfg.listen_slots}, "
+                f"payload_words={scfg.payload_words}, "
+                f"max_listeners={scfg.max_listeners} — gathers/scatters "
+                f"would wrap and silently corrupt stored values; shrink "
+                f"slots or payload_words (sharding does not help: the "
+                f"flat index space is global, not per-shard)")
+
+
 @partial(jax.jit, static_argnames=("n_nodes", "scfg"))
 def empty_store(n_nodes: int, scfg: StoreConfig) -> SwarmStore:
+    validate_store_geometry(n_nodes, scfg)
     n, s, ls = n_nodes, scfg.slots, scfg.listen_slots
     return SwarmStore(
         keys=jnp.zeros((n * s * N_LIMBS,), jnp.uint32),
@@ -220,6 +307,7 @@ def empty_store(n_nodes: int, scfg: StoreConfig) -> SwarmStore:
         cursor=jnp.zeros((n,), jnp.uint32),
         lkeys=jnp.zeros((n * ls * N_LIMBS,), jnp.uint32),
         lids=jnp.full((n * ls,), -1, jnp.int32),
+        lexps=jnp.zeros((n * ls,), jnp.uint32),
         lcursor=jnp.zeros((n,), jnp.uint32),
         notified=jnp.zeros((scfg.max_listeners,), bool),
         sizes=jnp.zeros((n, s), jnp.uint32),
@@ -441,7 +529,14 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     ls_n = store.lids.shape[0] // n_nodes                 # listen slots
     lid = jnp.stack([store.lids[n_safe * ls_n + j]
                      for j in range(ls_n)], axis=-1)      # [M,LS]
+    # Expired registrations stop matching lazily (0 = no expiry) —
+    # the reference drops listeners whose expiration passed without a
+    # re-register (src/dht.cpp:2299-2322); expire_listeners reclaims
+    # the rows, but correctness never depends on the sweep running.
+    lexp = jnp.stack([store.lexps[n_safe * ls_n + j]
+                      for j in range(ls_n)], axis=-1)     # [M,LS]
     lmatch = (lid >= 0) \
+        & ((lexp == 0) | (jnp.uint32(now) <= lexp)) \
         & _key_match(store.lkeys, n_safe, ls_n, s_key) \
         & accepted[:, None]
     lid_safe = jnp.clip(lid, 0, store.notified.shape[0] - 1)
@@ -535,21 +630,40 @@ def _announce_insert(alive: jax.Array, cfg: SwarmConfig,
     return store, rep_m[:p]
 
 
+def drop_exchanges(found: jax.Array, drop_frac: float,
+                   drop_key: jax.Array | None) -> jax.Array:
+    """Fault injection for the storage path, symmetric to the lookup
+    path's ``churn()``: lose a uniform ``drop_frac`` of the per-replica
+    announce/probe exchanges (each dropped entry is one storage RPC
+    that never arrives — the netem packet-loss analogue).  Dropped
+    replicas cost replication for the round and are healed by the next
+    maintenance sweep, exactly like reference announces lost under
+    load."""
+    if not drop_frac or drop_key is None:
+        return found
+    keep = jax.random.uniform(drop_key, found.shape) >= drop_frac
+    return jnp.where(keep, found, -1)
+
+
 def announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
              scfg: StoreConfig, keys: jax.Array, vals: jax.Array,
              seqs: jax.Array, now, rng: jax.Array,
              sizes: jax.Array | None = None,
              ttls: jax.Array | None = None,
-             payloads: jax.Array | None = None
+             payloads: jax.Array | None = None,
+             drop_frac: float = 0.0,
+             drop_key: jax.Array | None = None
              ) -> Tuple[SwarmStore, AnnounceReport]:
     """Batched put: lookup each key, store at its quorum closest alive
     nodes.  ``keys [P,5]``, ``vals [P]``, ``seqs [P]``; optional
     per-value ``sizes`` (budget accounting), ``ttls`` (per-type
     expiration), both ``[P]``, and real value bytes ``payloads
-    [P, scfg.payload_words]``."""
+    [P, scfg.payload_words]``.  ``drop_frac``/``drop_key`` inject
+    storage-RPC loss (see :func:`drop_exchanges`)."""
     res = _announce_targets(swarm, cfg, keys, rng)
+    found = drop_exchanges(res.found, drop_frac, drop_key)
     store, replicas = _announce_insert(
-        swarm.alive, cfg, store, scfg, res.found, keys, vals, seqs,
+        swarm.alive, cfg, store, scfg, found, keys, vals, seqs,
         jnp.uint32(now), sizes, ttls, payloads)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
                                  done=res.done)
@@ -635,7 +749,7 @@ def get_values(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
 def _listen_insert(alive: jax.Array, cfg: SwarmConfig,
                    store: SwarmStore,
                    scfg: StoreConfig, found: jax.Array, keys: jax.Array,
-                   reg_ids: jax.Array) -> SwarmStore:
+                   reg_ids: jax.Array, now: jax.Array) -> SwarmStore:
     ls = scfg.listen_slots
     p, q = found.shape
     req_node = _mask_dead_idx(alive, cfg, found.reshape(-1))
@@ -665,23 +779,103 @@ def _listen_insert(alive: jax.Array, cfg: SwarmConfig,
     nn = jnp.where(accept, s_node, cfg.n_nodes)
     lkeys = _key_write(store.lkeys, nn * ls + slot, s_key)
     lids = store.lids.at[nn * ls + slot].set(s_id, mode="drop")
+    exp = (jnp.uint32(now) + jnp.uint32(scfg.listen_ttl)
+           if scfg.listen_ttl else jnp.uint32(0))
+    lexps = store.lexps.at[nn * ls + slot].set(
+        jnp.broadcast_to(exp, s_id.shape), mode="drop")
     n_new = jnp.zeros_like(store.lcursor).at[
         jnp.where(accept, s_node, 0)].add(accept.astype(jnp.uint32))
-    return store._replace(lkeys=lkeys, lids=lids,
+    return store._replace(lkeys=lkeys, lids=lids, lexps=lexps,
                           lcursor=store.lcursor + n_new)
 
 
 def listen_at(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
               scfg: StoreConfig, keys: jax.Array, reg_ids: jax.Array,
-              rng: jax.Array) -> Tuple[SwarmStore, LookupResult]:
+              rng: jax.Array, now=0) -> Tuple[SwarmStore, LookupResult]:
     """Batched listen: register listener ``reg_ids [P]`` for ``keys
     [P,5]`` at each key's quorum closest nodes (``Dht::listenTo`` →
     ``storageAddListener``).  Subsequent announces of a key flip the
-    ``notified`` bit of its listeners."""
+    ``notified`` bit of its listeners and fill their delivery slots.
+    With ``scfg.listen_ttl`` set, registrations expire at ``now +
+    listen_ttl`` unless re-registered (:func:`refresh_listeners`)."""
     res = lookup(swarm, cfg, keys, rng)
     store = _listen_insert(swarm.alive, cfg, store, scfg, res.found,
-                           keys, reg_ids)
+                           keys, reg_ids, jnp.uint32(now))
     return store, res
+
+
+@partial(jax.jit, static_argnames=("scfg",))
+def refresh_listeners(store: SwarmStore, scfg: StoreConfig,
+                      active: jax.Array, now) -> SwarmStore:
+    """Re-register sweep: push the expiry of every table row whose
+    listener id is still ``active`` ([max_listeners] bool) out to
+    ``now + listen_ttl`` — the device twin of the reference's ~30 s
+    listener re-register (``Dht::listenTo`` keepalives,
+    /root/reference/src/dht.cpp:2299-2322).  Rows whose owner is not
+    in ``active`` keep their old deadline and lapse.  Elementwise over
+    the listener table, so the sharded store runs it shard-local.
+    No-op when ``listen_ttl`` is 0 (registrations never expire)."""
+    if not scfg.listen_ttl:
+        return store
+    lid_safe = jnp.clip(store.lids, 0, scfg.max_listeners - 1)
+    hit = (store.lids >= 0) & active[lid_safe]
+    exp = jnp.uint32(now) + jnp.uint32(scfg.listen_ttl)
+    return store._replace(lexps=jnp.where(hit, exp, store.lexps))
+
+
+@partial(jax.jit, static_argnames=("scfg",))
+def expire_listeners(store: SwarmStore, scfg: StoreConfig,
+                     now) -> SwarmStore:
+    """Reclaim listener-table rows whose expiry passed (lapsed
+    registrations already stop matching lazily inside the announce
+    path; this sweep frees their ring slots for new listeners)."""
+    dead = (store.lids >= 0) & (store.lexps > 0) \
+        & (store.lexps < jnp.uint32(now))
+    return store._replace(lids=jnp.where(dead, -1, store.lids))
+
+
+@partial(jax.jit, static_argnames=("scfg",))
+def cancel_listen(store: SwarmStore, scfg: StoreConfig,
+                  reg_ids: jax.Array) -> SwarmStore:
+    """Cancel listeners mesh-wide (``Dht::cancelListen``,
+    /root/reference/include/opendht/dht.h:341-351): every table row
+    registered to a canceled id dies on every node, and the canceled
+    ids' delivery slots clear.  ``reg_ids [P]`` int32; out-of-range
+    ids are ignored.  Elementwise over the listener table — the
+    sharded store runs it shard-local with zero communication."""
+    ml = scfg.max_listeners
+    safe = jnp.where((reg_ids >= 0) & (reg_ids < ml), reg_ids, ml)
+    cancel = jnp.zeros((ml,), bool).at[safe].set(True, mode="drop")
+    lid_safe = jnp.clip(store.lids, 0, ml - 1)
+    dead = (store.lids >= 0) & cancel[lid_safe]
+    return store._replace(
+        lids=jnp.where(dead, -1, store.lids),
+        notified=store.notified & ~cancel,
+        nseqs=jnp.where(cancel, 0, store.nseqs),
+        nvals=jnp.where(cancel, 0, store.nvals),
+        npayload=jnp.where(cancel[:, None], 0, store.npayload))
+
+
+@jax.jit
+def ack_listeners(store: SwarmStore, reg_ids: jax.Array) -> SwarmStore:
+    """Reader ack: consume the delivery slots of ``reg_ids [P]`` —
+    reset ``notified`` and the ``nseqs`` watermark (and the delivered
+    value/bytes) so the NEXT accepted announce of a listened-for key
+    re-delivers.  This is what makes the pub/sub path observe the
+    second and third change instead of firing once: without an ack the
+    slots keep freshest-wins semantics (the value updates in place),
+    with acks each change is a distinct consumable event.  After an
+    ack even a same-seq re-announce (or a stale replica's republish)
+    re-fires — matching the reference, where every ``storageChanged``
+    pushes and clients dedup by value id."""
+    ml = store.notified.shape[0]
+    safe = jnp.where((reg_ids >= 0) & (reg_ids < ml), reg_ids, ml)
+    ack = jnp.zeros((ml,), bool).at[safe].set(True, mode="drop")
+    return store._replace(
+        notified=store.notified & ~ack,
+        nseqs=jnp.where(ack, 0, store.nseqs),
+        nvals=jnp.where(ack, 0, store.nvals),
+        npayload=jnp.where(ack[:, None], 0, store.npayload))
 
 
 @partial(jax.jit, static_argnames=("scfg",))
@@ -699,7 +893,9 @@ def expire(store: SwarmStore, scfg: StoreConfig, now) -> SwarmStore:
 
 def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                    scfg: StoreConfig, node_idx: jax.Array, now,
-                   rng: jax.Array) -> Tuple[SwarmStore, AnnounceReport]:
+                   rng: jax.Array, drop_frac: float = 0.0,
+                   drop_key: jax.Array | None = None
+                   ) -> Tuple[SwarmStore, AnnounceReport]:
     """Chosen nodes re-announce every value they hold — the storage
     maintenance that restores replication after churn
     (``Dht::dataPersistence``, /root/reference/src/dht.cpp:2887-2947).
@@ -708,6 +904,9 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     ``M*slots`` stored values become one announce batch (unused slots
     are masked out by announcing to no one via key of an impossible
     put row — we simply reuse ``announce`` with masked lookups).
+    ``drop_frac``/``drop_key`` inject maintenance-RPC loss
+    (:func:`drop_exchanges`) — the chaos harness's knob for proving
+    survival degrades gracefully, not catastrophically.
     """
     s = scfg.slots
     n_safe = jnp.clip(node_idx, 0, cfg.n_nodes - 1)
@@ -729,6 +928,7 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     okf = ok.reshape(-1)
     res = lookup(swarm, cfg, keys, rng)
     found = jnp.where(okf[:, None], res.found, -1)
+    found = drop_exchanges(found, drop_frac, drop_key)
     store, replicas = _announce_insert(swarm.alive, cfg, store, scfg,
                                        found, keys, vals, seqs,
                                        jnp.uint32(now), sizes, ttls,

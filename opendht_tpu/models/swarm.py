@@ -296,7 +296,8 @@ def _build_ids(key: jax.Array, cfg: SwarmConfig) -> jax.Array:
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
 def _build_bucket(tables: jax.Array, ids0: jax.Array, b: jax.Array,
-                  key: jax.Array, cfg: SwarmConfig) -> jax.Array:
+                  key: jax.Array, cfg: SwarmConfig,
+                  alive: jax.Array | None = None) -> jax.Array:
     """Fill bucket ``b`` (traced scalar) of every node's table.
 
     Bucket ranges via prefix histograms, not binary search: in the
@@ -310,6 +311,13 @@ def _build_bucket(tables: jax.Array, ids0: jax.Array, b: jax.Array,
     ``tables`` is DONATED so the 10 GB buffer is updated in place —
     an unrolled whole-build jit kept a second table-sized buffer live
     and OOMed a 16 GB chip at 10M nodes.
+
+    With ``alive`` (a ``[N] bool`` mask), members are sampled among
+    ALIVE nodes only: the histogram weighs alive nodes, samples become
+    alive-ranks, and one ``searchsorted`` over the alive cumsum maps
+    ranks back to node indices (ids are sorted, so alive-rank order is
+    id order within every dyadic range) — :func:`heal_swarm`'s bucket
+    maintenance.
     """
     n, b_total, k = cfg.n_nodes, cfg.n_buckets, cfg.bucket_k
     assert b_total <= 26, "prefix histogram capped at 2^26 bins"
@@ -318,7 +326,9 @@ def _build_bucket(tables: jax.Array, ids0: jax.Array, b: jax.Array,
     # d ≥ 1 always (b_total ≥ 4), so the shift stays < 32.
     pref = (ids0 >> (jnp.uint32(32) - d.astype(jnp.uint32))
             ).astype(jnp.int32)
-    counts = jnp.zeros((1 << b_total,), jnp.int32).at[pref].add(1)
+    weight = (jnp.ones((n,), jnp.int32) if alive is None
+              else alive.astype(jnp.int32))
+    counts = jnp.zeros((1 << b_total,), jnp.int32).at[pref].add(weight)
     bounds = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)])
     p = jnp.where(inclusive, pref, pref ^ 1)   # own vs sibling interval
@@ -331,6 +341,13 @@ def _build_bucket(tables: jax.Array, ids0: jax.Array, b: jax.Array,
     samp = lo[:, None] + jnp.floor(
         strat * size[:, None]).astype(jnp.int32)
     samp = jnp.clip(samp, lo[:, None], hi[:, None] - 1)
+    if alive is not None:
+        # samp is an alive-RANK; the (r+1)-th alive node's index is
+        # the first position whose alive-cumsum exceeds r.
+        acum = jnp.cumsum(weight)
+        samp = jnp.clip(
+            jnp.searchsorted(acum, samp, side="right"), 0, n - 1
+        ).astype(jnp.int32)
     samp = jnp.where((hi > lo)[:, None], samp, -1)       # [N,K]
     if cfg.aug_tables:
         # Fused u16 row [idx-lo K | idx-hi K | window K].  The window
@@ -386,6 +403,35 @@ def churn(swarm: Swarm, key: jax.Array, kill_frac: float,
     """
     keep = jax.random.uniform(key, (cfg.n_nodes,)) >= kill_frac
     return swarm._replace(alive=swarm.alive & keep)
+
+
+def heal_swarm(swarm: Swarm, cfg: SwarmConfig, key: jax.Array) -> Swarm:
+    """Routing-table maintenance after churn: re-sample every bucket
+    among the ALIVE nodes.
+
+    The reference evicts expired members and refills buckets from
+    discovered traffic (``expireBuckets``/neighbourhood maintenance,
+    src/dht.cpp:2826-2885, 2991-3027); this is that process's steady
+    state, at the same modeling altitude as :func:`build_swarm` (which
+    samples the full-swarm steady state without simulating each ping).
+    Under heavy cumulative death the raw engine degrades exactly like
+    a reference node that never ran maintenance — buckets full of
+    corpses starve the lookup frontier (measured: recall of the true
+    alive-8-closest falls to ~0.5 at 24 % alive on 2048 nodes) — so
+    chaos scenarios pair ``churn`` with a heal, like the host cluster
+    pairs kills with virtual-time maintenance windows.
+
+    Same per-bucket donated-buffer build as :func:`build_swarm`: the
+    input swarm's table buffer is CONSUMED (donated); use the returned
+    swarm.  O(N·B) plus one ``searchsorted`` per sampled member.
+    """
+    tables = swarm.tables
+    ids0 = swarm.ids[:, 0]
+    for b in range(cfg.n_buckets):
+        tables = _build_bucket(tables, ids0, jnp.int32(b),
+                               jax.random.fold_in(key, b), cfg=cfg,
+                               alive=swarm.alive)
+    return swarm._replace(tables=tables)
 
 
 # ---------------------------------------------------------------------------
@@ -783,15 +829,19 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
 def burst_schedule(cfg: SwarmConfig) -> int:
     """First-burst round count: the MEASURED convergence depth
     (pending-by-round on v5e-1, 500k uniform lookups: 100k nodes → 7
-    rounds, 1M → 8, 10M → 9 ≈ ceil(log2 N / 2.56)).  Every extra
-    dispatched round costs a full-batch step (~97 ms at the north-star
-    config) whether or not anything is pending, while an undershoot
-    costs one ~100 ms scalar readback plus a 2-round top-up — so aim
-    exactly and let the done-check loop absorb seed variance.  The one
+    rounds, 1M → 8, 10M → 9 = ceil(log2 N / 2.65) at all three
+    calibration points).  The previous 2.56 divisor overshot the 10M
+    north star by one round — ceil(23.25/2.56) = 10 — dispatching a
+    ~97 ms full-batch step with nothing pending on every call; 2.65
+    lands 7/8/9 exactly (valid divisor window from the three points:
+    (2.583, 2.767]).  Every extra dispatched round costs a full-batch
+    step whether or not anything is pending, while an undershoot costs
+    one ~100 ms scalar readback plus a 2-round top-up — so aim exactly
+    and let the done-check loop absorb seed variance.  The one
     calibration constant shared by the local and sharded burst loops.
     """
     return min(cfg.max_steps,
-               max(6, math.ceil(math.log2(max(2, cfg.n_nodes)) / 2.56)))
+               max(6, math.ceil(math.log2(max(2, cfg.n_nodes)) / 2.65)))
 
 
 def run_burst_loop(step_fn, st: LookupState,

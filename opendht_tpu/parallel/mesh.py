@@ -24,6 +24,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS = "swarm"
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma=False):
+    """Version-compat ``shard_map``: newer jax exposes it as
+    ``jax.shard_map`` (with ``check_vma``); older runtimes (e.g. the
+    0.4.x line this container bakes in) only have
+    ``jax.experimental.shard_map.shard_map`` (with ``check_rep``).
+    One call-site API for both, so the sharded engine runs wherever
+    the package imports."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = AXIS) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:

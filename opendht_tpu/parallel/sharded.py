@@ -52,7 +52,7 @@ from ..models.swarm import (
     table_bytes,
 )
 from ..ops.xor_metric import prefix_len32
-from .mesh import AXIS
+from .mesh import AXIS, shard_map
 
 
 def data_parallel_lookup(swarm: Swarm, cfg: SwarmConfig,
@@ -323,7 +323,7 @@ def _sharded_lookup_while(swarm: Swarm, cfg: SwarmConfig,
     no input-output aliasing, so peak HBM is ~2× the table — only
     usable while that fits (the dispatcher below decides)."""
     n_shards = mesh.shape[AXIS]
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_sharded_body, cfg, n_shards, capacity_factor,
                 local_respond=local_respond),
         mesh=mesh,
@@ -370,7 +370,7 @@ def _st_specs():
 def _sharded_lookup_init(swarm, cfg, targets, key, mesh,
                          capacity_factor, local_respond=False):
     n_shards = mesh.shape[AXIS]
-    fn = jax.shard_map(
+    fn = shard_map(
         _make_respond_body(cfg, n_shards, capacity_factor,
                            local_respond, init=True),
         mesh=mesh,
@@ -384,7 +384,7 @@ def _sharded_lookup_init(swarm, cfg, targets, key, mesh,
 def _sharded_lookup_step(swarm, cfg, st, mesh, capacity_factor,
                          local_respond=False):
     n_shards = mesh.shape[AXIS]
-    fn = jax.shard_map(
+    fn = shard_map(
         _make_respond_body(cfg, n_shards, capacity_factor,
                            local_respond, init=False),
         mesh=mesh,

@@ -43,16 +43,22 @@ from ..models.storage import (
     SwarmStore,
     _key_match,
     _key_write,
+    _payload_digest,
     _pick_payload,
     _pl_gather,
     _segment_rank,
     _store_insert,
+    ack_listeners,
+    cancel_listen,
+    drop_exchanges,
     empty_store,
     expire,
+    expire_listeners,
+    refresh_listeners,
 )
 from ..models.swarm import Swarm, SwarmConfig
 from ..ops.xor_metric import N_LIMBS
-from .mesh import AXIS
+from .mesh import AXIS, shard_map
 from .sharded import _bucketize, _fill_buckets, sharded_lookup
 
 
@@ -99,7 +105,7 @@ def _route_back(resp: jax.Array, owner: jax.Array, pos: jax.Array,
 
 
 def _probe_refresh(store_local: SwarmStore, scfg: StoreConfig,
-                   r_node, r_key, r_seq, r_val, now):
+                   r_node, r_key, r_seq, r_val, r_dig, now):
     """Owner-side announce probe + refresh (one exchange).
 
     The reference's two-phase announce probes ``SELECT id,seq`` at each
@@ -107,8 +113,15 @@ def _probe_refresh(store_local: SwarmStore, scfg: StoreConfig,
     stale, sending a cheap ``refresh`` (TTL reset) otherwise
     (/root/reference/src/dht.cpp:1237-1339, refresh :1299-1307).  In
     the lock-step engine probe and refresh collapse into one routed
-    exchange: the owner classifies each (key, seq, val) probe against
-    its store shard and refreshes matching replicas in place.
+    exchange: the owner classifies each (key, seq, val, digest) probe
+    against its store shard and refreshes matching replicas in place.
+
+    ``r_dig`` is the announcer's payload digest
+    (:func:`opendht_tpu.models.storage._payload_digest`): "fresh same"
+    requires the stored BYTES to digest-match too, mirroring the edit
+    policy's "data exactly the same" test — an equal-seq same-token
+    different-bytes replica is a conflict (status 2), never counted as
+    a completed replica for the announcer's bytes.
 
     Returns ``(status [M], store_local)`` with status 0 = missing or
     stale (send the full value), 1 = fresh same-value replica
@@ -127,6 +140,10 @@ def _probe_refresh(store_local: SwarmStore, scfg: StoreConfig,
     cur_seq = store_local.seqs[n_safe, mslot]
     cur_val = store_local.vals[n_safe, mslot]
     fresh_same = valid & has & (cur_seq == r_seq) & (cur_val == r_val)
+    if scfg.payload_words:
+        cur_dig = _payload_digest(_pl_gather(
+            store_local.payload, n_safe * s + mslot, scfg.payload_words))
+        fresh_same = fresh_same & (cur_dig == r_dig)
     need_full = valid & (~has | (cur_seq < r_seq))
     status = jnp.where(fresh_same, 1,
                        jnp.where(need_full, 0, 2))
@@ -152,16 +169,17 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     accept bits back.
 
     ``probe=True`` enables the reference's two-phase announce (see
-    :func:`_probe_refresh`): a 9-word probe/refresh exchange first,
-    then the full-value exchange ONLY for replicas that reported
-    missing/stale, in buckets sized by ``full_capacity_factor`` (a
-    maintenance sweep expects most replicas to refresh, so the full
-    phase can be provisioned far below the probe phase; needy requests
-    past its capacity retry next sweep).  Returns
-    ``(store_local, replicas [ll])``.  The exchange's wire cost is
-    fully static — capacity buckets ship full-size regardless of fill
-    — so the traffic accounting lives in :func:`storage_wire_words`,
-    not on the device.
+    :func:`_probe_refresh`): a 10-word probe/refresh exchange first
+    (row + key5 + seq + val + payload digest, + the 1-word ack ride-
+    back), then the full-value exchange ONLY for replicas that
+    reported missing/stale, in buckets sized by
+    ``full_capacity_factor`` (a maintenance sweep expects most
+    replicas to refresh, so the full phase can be provisioned far
+    below the probe phase; needy requests past its capacity retry next
+    sweep).  Returns ``(store_local, replicas [ll])``.  The exchange's
+    wire cost is fully static — capacity buckets ship full-size
+    regardless of fill — so the traffic accounting lives in
+    :func:`storage_wire_words`, not on the device.
     """
     ll, quorum = found.shape
     shard_n = cfg.n_nodes // n_shards
@@ -177,18 +195,24 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     rep = lambda a: jnp.repeat(a, quorum, axis=0)
     refreshed = jnp.zeros((q,), bool)
     if probe:
+        dig = (_payload_digest(rep(payloads))
+               if w and payloads is not None
+               else jnp.zeros((q,), jnp.uint32))
         pcols = jnp.concatenate(
             [local_row[:, None], _u2i(rep(keys)),
-             _u2i(rep(seqs))[:, None], _u2i(rep(vals))[:, None]],
-            axis=1)                                      # [Q, 8]
+             _u2i(rep(seqs))[:, None], _u2i(rep(vals))[:, None],
+             _u2i(dig)[:, None]],
+            axis=1)                                      # [Q, 9]
         cap1 = _cap_for(q, n_shards, capacity_factor)
         rbuf, pos1, sent1 = _route_out(pcols, owner, ok, n_shards, cap1)
         p_node = rbuf[..., 0].reshape(-1)
         p_key = _i2u(rbuf[..., 1:1 + N_LIMBS]).reshape(-1, N_LIMBS)
         p_seq = _i2u(rbuf[..., 1 + N_LIMBS]).reshape(-1)
         p_val = _i2u(rbuf[..., 2 + N_LIMBS]).reshape(-1)
+        p_dig = _i2u(rbuf[..., 3 + N_LIMBS]).reshape(-1)
         status, store_local = _probe_refresh(store_local, scfg, p_node,
-                                             p_key, p_seq, p_val, now)
+                                             p_key, p_seq, p_val,
+                                             p_dig, now)
         back = _route_back(status.reshape(n_shards, cap1, 1), owner,
                            pos1, sent1, cap1)
         st = back[:, 0]
@@ -250,8 +274,9 @@ def storage_wire_words(cfg: SwarmConfig, scfg: StoreConfig,
     Static by construction: the collectives ship their full capacity
     buckets regardless of how many rows are real, so this is exact
     accounting, not an estimate.  With ``probe`` the full-value phase
-    shrinks to ``full_capacity_factor`` while a 9-word probe phase is
-    added — the reference's probe-then-put traffic shape
+    shrinks to ``full_capacity_factor`` while a 10-word probe phase
+    (9 request words incl. the payload digest, + 1 ack) is added — the
+    reference's probe-then-put traffic shape
     (/root/reference/src/dht.cpp:1237-1339), where re-announcing a
     value most replicas already hold costs probes, not payloads.
     """
@@ -261,7 +286,7 @@ def storage_wire_words(cfg: SwarmConfig, scfg: StoreConfig,
         return _cap_for(q, n_shards, capacity_factor) * n_shards * w_full
     fcf = (capacity_factor if full_capacity_factor is None
            else full_capacity_factor)
-    return (_cap_for(q, n_shards, capacity_factor) * n_shards * (8 + 1)
+    return (_cap_for(q, n_shards, capacity_factor) * n_shards * (9 + 1)
             + _cap_for(q, n_shards, fcf) * n_shards * w_full)
 
 
@@ -364,7 +389,7 @@ def _store_specs(mesh: Mesh) -> SwarmStore:
     return SwarmStore(
         keys=P(AXIS), vals=P(AXIS, None), seqs=P(AXIS, None),
         created=P(AXIS, None), used=P(AXIS, None), cursor=shd,
-        lkeys=P(AXIS), lids=P(AXIS), lcursor=shd,
+        lkeys=P(AXIS), lids=P(AXIS), lexps=P(AXIS), lcursor=shd,
         notified=P(), sizes=P(AXIS, None), ttls=P(AXIS, None),
         payload=P(AXIS), nseqs=P(), nvals=P(),
         npayload=P(None, None))
@@ -399,7 +424,7 @@ def _sharded_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                               probe=probe,
                               full_capacity_factor=full_capacity_factor)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(), specs, P(AXIS, None), P(AXIS, None), P(AXIS),
                   P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P()),
@@ -417,7 +442,9 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                      ttls: jax.Array | None = None,
                      payloads: jax.Array | None = None,
                      probe: bool = False,
-                     full_capacity_factor: float | None = None
+                     full_capacity_factor: float | None = None,
+                     drop_frac: float = 0.0,
+                     drop_key: jax.Array | None = None
                      ) -> Tuple[SwarmStore, AnnounceReport]:
     """Batched put over the sharded swarm + store.
 
@@ -427,7 +454,10 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     traced (a changing sim-time must not recompile).  ``probe``
     enables the reference's two-phase announce-with-probe (see
     :func:`_probe_refresh`; best for re-announces — a first put of
-    fresh keys pays the probe for nothing).
+    fresh keys pays the probe for nothing).  ``drop_frac``/``drop_key``
+    inject storage-RPC loss: a dropped replica target receives neither
+    the probe nor the value for this round (the chaos-harness packet-
+    loss knob, :func:`opendht_tpu.models.storage.drop_exchanges`).
 
     Two top-level phases — the routed lock-step lookup (which
     dispatches between its while-loop and burst formulations on table
@@ -443,8 +473,9 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     if payloads is None:
         payloads = jnp.zeros((p, scfg.payload_words), jnp.uint32)
     res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
+    found = drop_exchanges(res.found, drop_frac, drop_key)
     store, replicas = _sharded_insert(
-        swarm, cfg, store, scfg, res.found, keys, vals, seqs, sizes,
+        swarm, cfg, store, scfg, found, keys, vals, seqs, sizes,
         ttls, payloads, now, mesh, capacity_factor, probe,
         full_capacity_factor)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
@@ -458,7 +489,7 @@ def _sharded_probe_phase(swarm: Swarm, cfg: SwarmConfig,
                          keys, mesh: Mesh, capacity_factor: float):
     n_shards = mesh.shape[AXIS]
     specs = _store_specs(mesh)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_probe_phase_body, cfg, scfg, n_shards,
                 capacity_factor),
         mesh=mesh,
@@ -496,7 +527,10 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                       mesh: Mesh, capacity_factor: float = 4.0,
                       probe: bool = False,
                       full_capacity_factor: float | None = None,
-                      chunk: int = 262_144
+                      chunk: int = 262_144,
+                      node_range: Tuple[int, int] | None = None,
+                      drop_frac: float = 0.0,
+                      drop_key: jax.Array | None = None
                       ) -> Tuple[SwarmStore, AnnounceReport]:
     """Mesh-wide storage maintenance: every alive node re-announces its
     stored values to the keys' current quorum-closest — the sharded
@@ -514,14 +548,25 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     (e.g. expected churn-lost fraction × capacity_factor): that is
     where the wire saving lands, since capacity buckets ship full-size
     regardless of fill.  With the default (full) provisioning a probe
-    sweep COSTS 9 extra words per slot; maintenance is exactly the
+    sweep COSTS 10 extra words per slot; maintenance is exactly the
     workload where a shrunk full phase is safe, because most replicas
     answer the probe with a refresh (``bench.py --mode repub``
     measures the trade).
+
+    Chaos knobs: ``node_range=(lo, hi)`` restricts the sweep to that
+    republisher range (both multiples of the mesh size), letting a
+    harness kill nodes MID-maintenance — sweep the first half, churn,
+    sweep the rest; ``drop_frac``/``drop_key`` lose a fraction of the
+    announce/probe exchanges (:func:`opendht_tpu.models.storage.
+    drop_exchanges`).
     """
     n_shards = mesh.shape[AXIS]
     s = scfg.slots
-    n = cfg.n_nodes
+    lo0, hi0 = node_range if node_range is not None else (0, cfg.n_nodes)
+    n = hi0 - lo0
+    assert 0 <= lo0 < hi0 <= cfg.n_nodes \
+        and lo0 % n_shards == 0 and n % n_shards == 0, (
+            lo0, hi0, n_shards)
     # Chunk by NODE RANGE, boundaries aligned to whole nodes and the
     # mesh: each chunk slices the live store leaves directly (no
     # full-store snapshot copies held across the sweep — at 10M nodes
@@ -532,7 +577,7 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     while n % cn:
         cn -= n_shards
     reps, hops, done = [], [], []
-    for i, nlo in enumerate(range(0, n, cn)):
+    for i, nlo in enumerate(range(lo0, hi0, cn)):
         nsl = slice(nlo, nlo + cn)
         keys = store.keys[nlo * s * N_LIMBS:
                           (nlo + cn) * s * N_LIMBS].reshape(cn * s,
@@ -544,6 +589,9 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                              jax.random.fold_in(key, i), mesh,
                              capacity_factor)
         found = jnp.where(okf[:, None], res.found, -1)
+        found = drop_exchanges(
+            found, drop_frac,
+            None if drop_key is None else jax.random.fold_in(drop_key, i))
         store, replicas = _sharded_insert(
             swarm, cfg, store, scfg, found, keys,
             store.vals[nsl].reshape(-1), store.seqs[nsl].reshape(-1),
@@ -572,11 +620,12 @@ def sharded_expire(store: SwarmStore, scfg: StoreConfig,
 
 def _listen_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
                  capacity_factor: float, alive,
-                 store_local: SwarmStore, found, keys, reg_ids):
+                 store_local: SwarmStore, found, keys, reg_ids, now):
     """Per-shard listen phase: routed listener-table inserts (ring
     slots, ≤ listen_slots per node per batch) against the replicas a
     lookup ``found`` — the sharded ``Dht::storageAddListener``
-    (/root/reference/src/dht.cpp:2299-2322)."""
+    (/root/reference/src/dht.cpp:2299-2322).  Rows expire at
+    ``now + scfg.listen_ttl`` (0 = never) unless refreshed."""
     from ..models.storage import INT32_MAX
 
     ll, quorum = found.shape
@@ -621,38 +670,83 @@ def _listen_body(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     nn = jnp.where(accept, s_node, rows)
     lkeys = _key_write(store_local.lkeys, nn * ls + slot, s_key)
     lids = store_local.lids.at[nn * ls + slot].set(s_id, mode="drop")
+    exp = (jnp.uint32(now) + jnp.uint32(scfg.listen_ttl)
+           if scfg.listen_ttl else jnp.uint32(0))
+    lexps = store_local.lexps.at[nn * ls + slot].set(
+        jnp.broadcast_to(exp, s_id.shape), mode="drop")
     n_new = jnp.zeros_like(store_local.lcursor).at[
         jnp.where(accept, s_node, 0)].add(accept.astype(jnp.uint32))
     store_local = store_local._replace(
-        lkeys=lkeys, lids=lids, lcursor=store_local.lcursor + n_new)
+        lkeys=lkeys, lids=lids, lexps=lexps,
+        lcursor=store_local.lcursor + n_new)
     return store_local
 
 
 @partial(jax.jit,
          static_argnames=("cfg", "scfg", "mesh", "capacity_factor"))
 def _sharded_listen_phase(swarm, cfg, store, scfg, found, keys,
-                          reg_ids, mesh, capacity_factor):
+                          reg_ids, now, mesh, capacity_factor):
     n_shards = mesh.shape[AXIS]
     specs = _store_specs(mesh)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_listen_body, cfg, scfg, n_shards, capacity_factor),
         mesh=mesh,
-        in_specs=(P(), specs, P(AXIS, None), P(AXIS, None), P(AXIS)),
+        in_specs=(P(), specs, P(AXIS, None), P(AXIS, None), P(AXIS),
+                  P()),
         out_specs=specs, check_vma=False)
-    return fn(swarm.alive, store, found, keys, reg_ids)
+    return fn(swarm.alive, store, found, keys, reg_ids,
+              jnp.uint32(now))
 
 
 def sharded_listen_at(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                       scfg: StoreConfig, keys: jax.Array,
                       reg_ids: jax.Array, key: jax.Array, mesh: Mesh,
-                      capacity_factor: float = 4.0
+                      capacity_factor: float = 4.0, now=0
                       ) -> Tuple[SwarmStore, jax.Array]:
     """Batched listen over the mesh: register listener ``reg_ids [P]``
     for ``keys [P,5]`` at each key's quorum-closest nodes; subsequent
     ``sharded_announce``/``sharded_republish`` of a key push the
     changed value into its listeners' delivery slots (merged
-    mesh-wide).  Same two-phase shape as :func:`sharded_announce`."""
+    mesh-wide).  Same two-phase shape as :func:`sharded_announce`.
+    With ``scfg.listen_ttl`` set, registrations expire at ``now +
+    listen_ttl`` unless refreshed (:func:`sharded_refresh_listeners`)."""
     res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
     store = _sharded_listen_phase(swarm, cfg, store, scfg, res.found,
-                                  keys, reg_ids, mesh, capacity_factor)
+                                  keys, reg_ids, now, mesh,
+                                  capacity_factor)
     return store, res.done
+
+
+# The listener-lifecycle sweeps are elementwise over the (sharded)
+# listener table with replicated id masks — XLA runs them shard-local
+# under the store's NamedSharding with zero communication, so the
+# single-chip ops ARE the sharded ones (same pattern as
+# :func:`sharded_expire`).  Re-exported under sharded_* names so call
+# sites read symmetrically with the other mesh ops.
+
+def sharded_cancel_listen(store: SwarmStore, scfg: StoreConfig,
+                          reg_ids: jax.Array) -> SwarmStore:
+    """Mesh-wide ``Dht::cancelListen``: the canceled ids' table rows
+    die on EVERY shard and their (replicated) delivery slots clear."""
+    return cancel_listen(store, scfg, reg_ids)
+
+
+def sharded_refresh_listeners(store: SwarmStore, scfg: StoreConfig,
+                              active: jax.Array, now) -> SwarmStore:
+    """Mesh-wide listener re-register sweep (the reference's ~30 s
+    keepalive): rows of ``active`` ids get expiry ``now+listen_ttl``."""
+    return refresh_listeners(store, scfg, active, now)
+
+
+def sharded_expire_listeners(store: SwarmStore, scfg: StoreConfig,
+                             now) -> SwarmStore:
+    """Mesh-wide reclaim of lapsed listener registrations."""
+    return expire_listeners(store, scfg, now)
+
+
+def sharded_ack_listeners(store: SwarmStore,
+                          reg_ids: jax.Array) -> SwarmStore:
+    """Mesh-wide reader ack: consume delivery slots so the next
+    accepted announce re-delivers (see
+    :func:`opendht_tpu.models.storage.ack_listeners`)."""
+    return ack_listeners(store, reg_ids)

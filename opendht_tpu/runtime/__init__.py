@@ -1,3 +1,18 @@
 """Runtime facade: threaded DhtRunner over real or virtual transports."""
 
-from .dhtrunner import DhtRunner, DhtRunnerConfig  # noqa: F401
+# DhtRunner sits on the crypto layer (SecureDht); in containers without
+# the optional ``cryptography`` wheel the import is gated so the rest
+# of the runtime package (NodeSet) stays usable — same policy as the
+# top-level ``opendht_tpu`` facade.
+try:
+    from .dhtrunner import DhtRunner, DhtRunnerConfig  # noqa: F401
+except ImportError as _e:  # pragma: no cover — dep-less containers
+    _RUNNER_IMPORT_ERROR = _e
+
+    def __getattr__(name: str):
+        if name in ("DhtRunner", "DhtRunnerConfig"):
+            raise ImportError(
+                f"opendht_tpu.runtime.{name} requires the optional "
+                f"crypto dependencies: {_RUNNER_IMPORT_ERROR}")
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")

@@ -18,6 +18,8 @@ search sets).  Two legs:
   ``models/storage._store_insert``).
 """
 
+import importlib.util
+
 import numpy as np
 import pytest
 
@@ -27,6 +29,9 @@ import jax.numpy as jnp
 from dht_harness import SimCluster
 from opendht_tpu.models.swarm import SwarmConfig, build_swarm, lookup
 from opendht_tpu.utils.infohash import InfoHash
+
+HAS_CRYPTO = (importlib.util.find_spec("cryptography") is not None
+              and importlib.util.find_spec("argon2") is not None)
 
 N_NODES = 1024
 N_LOOKUPS = 200
@@ -172,16 +177,23 @@ def check_replica_outcomes(step, pairs):
 
 KILL_FRAC = 0.5
 CHURN_CYCLES = 2
+# 96 values: 1/96 ≈ 1 pp survival granularity on the host leg, so the
+# tightened 0.10 band below is dominated by real maintenance behavior,
+# not by counting noise (the old 48-value leg quantized at 2 pp).
+N_MAINT_VALS = 96
 
 
 def host_maintenance_survival():
     """Two kill-half cycles through the host cluster with storage
-    maintenance between them (``Dht::dataPersistence``, ref
-    src/dht.cpp:2887-2947): put values, partition half the nodes,
-    let maintenance republish, repeat, then re-get from a survivor.
+    maintenance between them: put values, gracefully shut down half
+    the nodes (``Dht::shutdown`` hands storage off to the remaining
+    closest — ref src/dht.cpp:736-761 — the same scenario as
+    BASELINE.md's "persistence delete", whose 7/8-after-killing-ALL-
+    hosting-nodes result is only reachable via that handoff), let
+    maintenance settle, repeat, then re-get from a survivor.
 
-    The maintenance period is shrunk (white-box) so two full republish
-    sweeps fit inside the values' 10-min TTL on the virtual clock.
+    The maintenance period is shrunk (white-box) so full maintenance
+    cycles fit inside the values' 10-min TTL on the virtual clock.
     """
     import opendht_tpu.core.dht as core_dht
     from opendht_tpu.core.value import Value
@@ -189,7 +201,7 @@ def host_maintenance_survival():
     old_period = core_dht.MAX_STORAGE_MAINTENANCE_EXPIRE_TIME
     core_dht.MAX_STORAGE_MAINTENANCE_EXPIRE_TIME = 20.0
     try:
-        n, n_vals = 64, 48
+        n, n_vals = 64, N_MAINT_VALS
         c = SimCluster(n, seed=13)
         for d in c.nodes:
             d.config.maintain_storage = True   # the ref opt-in flag
@@ -213,11 +225,22 @@ def host_maintenance_survival():
             doomed = [d for d in alive
                       if rng.random() < KILL_FRAC or
                       (cycle == 0 and d is writer)]
+            # Graceful exit: each doomed node hands its storage off to
+            # the current closest nodes (Dht::shutdown → forced
+            # maintainStorage), then drops off the network.  This is
+            # the replication-restoring maintenance the device leg's
+            # republish sweep mirrors; an abrupt kill instead erodes
+            # replication monotonically (the reference's conditional
+            # maintainStorage only republishes DISPLACED holders, and
+            # mass death never displaces survivors).
+            for d in doomed:
+                d.shutdown()
+            c.run(10.0)     # let the handoff announces complete
             for d in doomed:
                 c.kill(d)
             alive = [d for d in alive if d not in doomed]
             assert len(alive) >= 4, "churn killed nearly everything"
-            # Two maintenance periods: displaced holders republish.
+            # Maintenance windows: routing tables expire the corpses.
             c.run(45.0)
 
         reader = alive[-1]
@@ -237,12 +260,15 @@ def host_maintenance_survival():
 
 def device_maintenance_survival():
     """The same two kill-half cycles through the device engine:
-    churn → ``republish_from`` every alive node → re-get
+    churn → ``heal_swarm`` (the routing-table maintenance the host
+    cluster gets from its virtual-time windows — without it the device
+    leg measures stale-table lookup starvation, not storage
+    maintenance) → ``republish_from`` every alive node → re-get
     (models/storage, the sim ``dataPersistence``)."""
     from opendht_tpu.models.storage import (
         StoreConfig, announce, empty_store, get_values, republish_from,
     )
-    from opendht_tpu.models.swarm import churn
+    from opendht_tpu.models.swarm import churn, heal_swarm
 
     cfg = SwarmConfig.for_nodes(2048)
     sw = build_swarm(jax.random.PRNGKey(21), cfg)
@@ -259,6 +285,7 @@ def device_maintenance_survival():
     for cycle in range(CHURN_CYCLES):
         dead = churn(dead, jax.random.PRNGKey(30 + cycle), KILL_FRAC,
                      cfg)
+        dead = heal_swarm(dead, cfg, jax.random.PRNGKey(60 + cycle))
         store, _ = republish_from(dead, cfg, store, scfg, all_idx,
                                   1 + cycle,
                                   jax.random.PRNGKey(40 + cycle))
@@ -271,20 +298,25 @@ def device_maintenance_survival():
 def test_maintenance_conformance():
     """One spec, two engines — enforced for MAINTENANCE, not just
     lookups: at a matched kill fraction and cycle count, the host
-    cluster's natural republish and the device engine's maintenance
-    sweep must land survival in the same band (ref scenario:
-    PersistenceTest, python/tools/dht/tests.py:439-827)."""
+    cluster's handoff+maintenance and the device engine's
+    heal+republish sweep must land survival in the same band (ref
+    scenario: PersistenceTest, python/tools/dht/tests.py:439-827).
+
+    The band is 0.10 (down from 0.15) with per-leg floors at 0.95/0.9:
+    a 10 % maintenance regression in either engine now FAILS.
+    Measured on this harness: host 1.0, device ~0.986 vs the
+    (1 - 0.5^8)^2 ≈ 0.992 theory floor for full re-replication
+    between cycles.
+    """
     s_host = host_maintenance_survival()
     s_dev = device_maintenance_survival()
-    # Theory floor at these parameters: one cycle loses a replica set
-    # with p = KILL_FRAC^8; with republish restoring replication
-    # between cycles, survival ≈ (1 - 0.5^8)^2 ≈ 0.992.  48-value host
-    # granularity and routing imperfection widen the band.
-    assert s_dev > 0.9, s_dev
-    assert s_host > 0.8, s_host
-    assert abs(s_host - s_dev) < 0.15, (s_host, s_dev)
+    assert s_dev > 0.95, s_dev
+    assert s_host > 0.9, s_host
+    assert abs(s_host - s_dev) < 0.10, (s_host, s_dev)
 
 
+@pytest.mark.skipif(not HAS_CRYPTO,
+                    reason="optional crypto deps absent")
 def test_storage_seq_semantics_host():
     """Host engine: announce the SEQ_STEPS as SIGNED values through a
     secure-node cluster and check the REPLICA STATE at the key's true

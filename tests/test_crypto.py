@@ -6,6 +6,9 @@ Scheme parity targets: ref src/crypto.cpp:299-313 (RSA-SHA512 sign),
 
 import pytest
 
+pytest.importorskip("cryptography", reason="optional crypto deps absent")
+pytest.importorskip("argon2", reason="optional crypto deps absent")
+
 from opendht_tpu.crypto.identity import (Certificate, DecryptError, Identity,
                                          PrivateKey, PublicKey, aes_decrypt,
                                          aes_encrypt, generate_identity,

@@ -84,6 +84,41 @@ def test_listen_receives_later_put():
     assert not any(v.data == b"after-cancel" for v in heard)
 
 
+def test_listen_canceled_stops_while_active_sees_successive_changes():
+    """Host-path twin of the device listener-lifecycle tests: after a
+    cancel, the canceled listener goes silent while an active listener
+    on the same key observes TWO further published changes (ref:
+    Dht::cancelListen, include/opendht/dht.h:341-351)."""
+    c = SimCluster(8)
+    c.bootstrap_all()
+    c.run(2.0)
+    key = InfoHash.get("lifecycle-channel")
+
+    heard_a, heard_b = [], []
+    tok_a = c.nodes[2].listen(
+        key, lambda vals: (heard_a.extend(vals), True)[1])
+    c.nodes[4].listen(key, lambda vals: (heard_b.extend(vals), True)[1])
+    c.run(3.0)
+
+    c.nodes[6].put(key, Value(b"change-1", value_id=1))
+    assert c.run_until(lambda: heard_a and heard_b, 60.0)
+    assert any(v.data == b"change-1" for v in heard_a)
+    assert any(v.data == b"change-1" for v in heard_b)
+
+    c.nodes[2].cancel_listen(key, tok_a)
+    heard_a.clear(), heard_b.clear()
+    # Two successive further changes: the active listener sees both,
+    # the canceled one sees neither.
+    c.nodes[6].put(key, Value(b"change-2", value_id=2))
+    assert c.run_until(
+        lambda: any(v.data == b"change-2" for v in heard_b), 60.0)
+    c.nodes[6].put(key, Value(b"change-3", value_id=3))
+    assert c.run_until(
+        lambda: any(v.data == b"change-3" for v in heard_b), 60.0)
+    c.run(10.0)
+    assert not heard_a, [v.data for v in heard_a]
+
+
 def test_value_filter_where():
     c = SimCluster(6)
     c.bootstrap_all()

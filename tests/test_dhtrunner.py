@@ -5,6 +5,9 @@ import time
 
 import pytest
 
+pytest.importorskip("cryptography", reason="optional crypto deps absent")
+pytest.importorskip("argon2", reason="optional crypto deps absent")
+
 from opendht_tpu.core.value import Value
 from opendht_tpu.runtime import DhtRunner
 from opendht_tpu.utils.infohash import InfoHash

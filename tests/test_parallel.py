@@ -265,6 +265,171 @@ def test_storage_wire_words_probe_shrinks_traffic():
     assert probed < 0.65 * full, (probed, full)
 
 
+def test_sharded_listener_lifecycle_mesh_wide():
+    """TTL + ack + cancel on the node-sharded listener table: a
+    canceled/expired listener stops receiving mesh-wide while an
+    active one observes two successive value changes."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.models.storage import StoreConfig
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_ack_listeners, sharded_announce, sharded_cancel_listen,
+        sharded_empty_store, sharded_listen_at,
+        sharded_refresh_listeners,
+    )
+
+    cfg, sw, _, mesh, keys, vals, seqs = _mk_sharded_store_env(p=64)
+    scfg = StoreConfig(slots=8, listen_slots=2, max_listeners=256,
+                       listen_ttl=100)
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    regs = jnp.arange(64, dtype=jnp.int32)
+    store, done = sharded_listen_at(sw, cfg, store, scfg, keys, regs,
+                                    jax.random.PRNGKey(2), mesh,
+                                    capacity_factor=float("inf"), now=0)
+    assert bool(jnp.all(done))
+    # change 1
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals, seqs,
+                                1, jax.random.PRNGKey(3), mesh,
+                                capacity_factor=float("inf"))
+    n1 = np.asarray(store.notified)[:64]
+    assert n1.mean() > 0.9
+    # ack consumes; change 2 re-delivers the NEW value
+    store = sharded_ack_listeners(store, regs)
+    assert not bool(jnp.any(store.notified))
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals + 50,
+                                seqs + 1, 2, jax.random.PRNGKey(4),
+                                mesh, capacity_factor=float("inf"))
+    n2 = np.asarray(store.notified)[:64]
+    got = np.asarray(store.nvals)[:64]
+    assert n2.mean() > 0.9
+    assert (got[n2] == np.asarray(vals + 50)[n2]).all()
+    # cancel half mesh-wide; change 3 must not leak to them.  The
+    # surviving half is refreshed past its original expiry and must
+    # still fire at now=150 > registration + ttl.
+    store = sharded_cancel_listen(store, scfg, regs[:32])
+    act = jnp.zeros((256,), bool).at[regs[32:]].set(True)
+    store = sharded_refresh_listeners(store, scfg, act, 90)
+    store = sharded_ack_listeners(store, regs)
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals + 99,
+                                seqs + 2, 150, jax.random.PRNGKey(5),
+                                mesh, capacity_factor=float("inf"))
+    n3 = np.asarray(store.notified)[:64]
+    assert not n3[:32].any(), "canceled listener still delivered"
+    assert n3[32:].mean() > 0.9, "refreshed listener lapsed"
+
+
+def test_sharded_listener_ttl_expires_unrefreshed():
+    """An unrefreshed TTL'd registration lapses mesh-wide: announces
+    past its expiry deliver nothing."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.models.storage import StoreConfig
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_listen_at,
+    )
+
+    cfg, sw, _, mesh, keys, vals, seqs = _mk_sharded_store_env(p=64)
+    scfg = StoreConfig(slots=8, listen_slots=2, max_listeners=256,
+                       listen_ttl=10)
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    regs = jnp.arange(64, dtype=jnp.int32)
+    store, _ = sharded_listen_at(sw, cfg, store, scfg, keys, regs,
+                                 jax.random.PRNGKey(2), mesh,
+                                 capacity_factor=float("inf"), now=0)
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals, seqs,
+                                50, jax.random.PRNGKey(3), mesh,
+                                capacity_factor=float("inf"))
+    assert not bool(jnp.any(store.notified)), \
+        "expired listeners still delivered"
+
+
+def test_sharded_probe_digest_rejects_different_bytes():
+    """ADVICE round 5 (low): an equal-seq same-token DIFFERENT-bytes
+    replica must not be counted as a completed replica by the probe —
+    the digest folds payload identity into fresh_same, matching the
+    edit policy's 'data exactly the same'."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.models.storage import StoreConfig
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store,
+    )
+
+    cfg, sw, _, mesh, keys, vals, seqs = _mk_sharded_store_env(p=64)
+    scfg = StoreConfig(slots=8, listen_slots=2, max_listeners=256,
+                       payload_words=4)
+    pls_x = jax.random.bits(jax.random.PRNGKey(5), (64, 4), jnp.uint32)
+    pls_y = pls_x ^ jnp.uint32(1)            # same seq/token, new bytes
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals, seqs,
+                                0, jax.random.PRNGKey(2), mesh,
+                                capacity_factor=float("inf"),
+                                payloads=pls_x)
+    # Probe re-announce of the SAME bytes: replicas complete via
+    # refresh even with the full phase squeezed to near zero.
+    store, rep_same = sharded_announce(sw, cfg, store, scfg, keys, vals,
+                                       seqs, 1, jax.random.PRNGKey(3),
+                                       mesh,
+                                       capacity_factor=float("inf"),
+                                       probe=True,
+                                       full_capacity_factor=0.01,
+                                       payloads=pls_x)
+    # Probe re-announce of DIFFERENT bytes at the same seq: the digest
+    # mismatch must classify every replica as a conflict — nothing
+    # refreshes, and the edit policy would reject the full value
+    # anyway, so the announce completes (correctly) almost nowhere.
+    store, rep_diff = sharded_announce(sw, cfg, store, scfg, keys, vals,
+                                       seqs, 2, jax.random.PRNGKey(4),
+                                       mesh,
+                                       capacity_factor=float("inf"),
+                                       probe=True,
+                                       full_capacity_factor=0.01,
+                                       payloads=pls_y)
+    r_same = float(jnp.mean(rep_same.replicas))
+    r_diff = float(jnp.mean(rep_diff.replicas))
+    assert r_same > 5, r_same
+    assert r_diff < 0.25 * r_same, (r_same, r_diff)
+
+
+def test_sharded_republish_node_range_and_drop_equals_full_sweep():
+    """Chaos knobs keep semantics: two half-range sweeps (with churn
+    injected between them) plus exchange loss still restore get-
+    ability, and values stay intact."""
+    import jax
+    import jax.numpy as jnp
+    from opendht_tpu.models.swarm import churn
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_get,
+        sharded_republish,
+    )
+
+    cfg, sw, scfg, mesh, keys, vals, seqs = _mk_sharded_store_env()
+    store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+    store, _ = sharded_announce(sw, cfg, store, scfg, keys, vals, seqs,
+                                0, jax.random.PRNGKey(2), mesh,
+                                capacity_factor=float("inf"))
+    half = cfg.n_nodes // 2 // 8 * 8
+    dead = sw
+    store, _ = sharded_republish(dead, cfg, store, scfg, 1,
+                                 jax.random.PRNGKey(8), mesh,
+                                 capacity_factor=float("inf"),
+                                 node_range=(0, half), drop_frac=0.2,
+                                 drop_key=jax.random.PRNGKey(9))
+    dead = churn(dead, jax.random.PRNGKey(7), 0.5, cfg)  # mid-sweep
+    store, _ = sharded_republish(dead, cfg, store, scfg, 2,
+                                 jax.random.PRNGKey(10), mesh,
+                                 capacity_factor=float("inf"),
+                                 node_range=(half, cfg.n_nodes),
+                                 drop_frac=0.2,
+                                 drop_key=jax.random.PRNGKey(11))
+    res = sharded_get(dead, cfg, store, scfg, keys,
+                      jax.random.PRNGKey(12), mesh,
+                      capacity_factor=float("inf"))
+    assert float(jnp.mean(res.hit)) > 0.9, float(jnp.mean(res.hit))
+    ok = jnp.where(res.hit, res.val == vals, True)
+    assert bool(jnp.all(ok)), "chaos sweep corrupted values"
+
+
 def test_sharded_expire_ttl_sweep():
     """Per-value TTLs must expire on the sharded store exactly as on
     the single-chip one (Storage::expire, src/dht.cpp:2361-2381)."""

@@ -4,6 +4,11 @@ import os
 import tempfile
 import time
 
+import pytest
+
+pytest.importorskip("cryptography", reason="optional crypto deps absent")
+pytest.importorskip("argon2", reason="optional crypto deps absent")
+
 from opendht_tpu import DhtRunner, InfoHash, NodeSet, SockAddr, Value
 from opendht_tpu.core.default_types import (
     IceCandidates, ImMessage, TrustRequest,

@@ -7,6 +7,9 @@ once per module at reduced key length.
 
 import pytest
 
+pytest.importorskip("cryptography", reason="optional crypto deps absent")
+pytest.importorskip("argon2", reason="optional crypto deps absent")
+
 from opendht_tpu.core.value import Value
 from opendht_tpu.crypto.identity import generate_identity
 from opendht_tpu.crypto.securedht import (

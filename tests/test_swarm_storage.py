@@ -15,14 +15,20 @@ import pytest
 from opendht_tpu.models.storage import (
     StoreConfig,
     _store_insert,
+    ack_listeners,
     announce,
+    cancel_listen,
     empty_store,
     expire,
+    expire_listeners,
     get_values,
     listen_at,
+    refresh_listeners,
     republish_from,
 )
-from opendht_tpu.models.swarm import SwarmConfig, build_swarm, churn
+from opendht_tpu.models.swarm import (
+    SwarmConfig, build_swarm, churn, heal_swarm,
+)
 
 
 @pytest.fixture(scope="module")
@@ -282,6 +288,232 @@ class TestListen:
         assert int(store.nseqs[7]) == 7       # delivered seq 6, +1
 
 
+class TestListenerLifecycle:
+    """TTL'd, refreshable, cancelable listeners with CONSUMABLE
+    delivery slots — the device twin of the reference's expiring
+    registrations + 30 s re-register + cancelListen
+    (src/dht.cpp:2299-2322, include/opendht/dht.h:341-351)."""
+
+    def test_ack_consumes_and_second_change_redelivers(self, small_swarm):
+        """A listener must observe the second and third change, not
+        just the first: ack consumes the slot, the next accepted
+        announce re-fills it."""
+        swarm, cfg = small_swarm
+        scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=64)
+        store = empty_store(cfg.n_nodes, scfg)
+        key = _rand_keys(90, 1)
+        reg = jnp.asarray([5], jnp.int32)
+        store, _ = listen_at(swarm, cfg, store, scfg, key, reg,
+                             jax.random.PRNGKey(91))
+        for step, (val, seq) in enumerate(((10, 1), (20, 2), (30, 3))):
+            store, _ = announce(swarm, cfg, store, scfg, key,
+                                jnp.asarray([val], jnp.uint32),
+                                jnp.asarray([seq], jnp.uint32), step,
+                                jax.random.PRNGKey(92 + step))
+            assert bool(store.notified[5]), f"change {step} not delivered"
+            assert int(store.nvals[5]) == val
+            assert int(store.nseqs[5]) == seq + 1
+            store = ack_listeners(store, reg)
+            assert not bool(store.notified[5])
+            assert int(store.nseqs[5]) == 0 and int(store.nvals[5]) == 0
+
+    def test_canceled_listener_stops_while_active_sees_republished(
+            self, small_swarm):
+        """The satellite scenario: a canceled listener goes silent
+        while an active one observes two successive republished
+        values (device path; host path: test_dht.py)."""
+        swarm, cfg = small_swarm
+        scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=64)
+        store = empty_store(cfg.n_nodes, scfg)
+        key = _rand_keys(100, 1)
+        keys2 = jnp.tile(key, (2, 1))
+        regs = jnp.asarray([3, 7], jnp.int32)
+        store, _ = listen_at(swarm, cfg, store, scfg, keys2, regs,
+                             jax.random.PRNGKey(101))
+        # change 1
+        store, _ = announce(swarm, cfg, store, scfg, key,
+                            jnp.asarray([11], jnp.uint32),
+                            jnp.asarray([1], jnp.uint32), 0,
+                            jax.random.PRNGKey(102))
+        n = np.asarray(store.notified)
+        assert bool(n[3]) and bool(n[7])
+        store = ack_listeners(store, regs)
+        store = cancel_listen(store, scfg, jnp.asarray([3], jnp.int32))
+        # change 2: a fresher value, republished after churn so the
+        # delivery rides the maintenance path, not just the put path.
+        store, _ = announce(swarm, cfg, store, scfg, key,
+                            jnp.asarray([22], jnp.uint32),
+                            jnp.asarray([2], jnp.uint32), 1,
+                            jax.random.PRNGKey(103))
+        dead = churn(swarm, jax.random.PRNGKey(104), 0.3, cfg)
+        store = ack_listeners(store, jnp.asarray([7], jnp.int32))
+        all_idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+        store, _ = republish_from(dead, cfg, store, scfg, all_idx, 2,
+                                  jax.random.PRNGKey(105))
+        n = np.asarray(store.notified)
+        assert not bool(n[3]), "canceled listener still delivered"
+        assert bool(n[7]), "active listener missed the republish"
+        assert int(store.nvals[7]) == 22
+
+    def test_listener_ttl_expiry_and_refresh(self, small_swarm):
+        """An unrefreshed registration lapses at its expiry; a
+        refreshed one outlives it (the 30 s re-register)."""
+        swarm, cfg = small_swarm
+        scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=64,
+                           listen_ttl=100)
+        store = empty_store(cfg.n_nodes, scfg)
+        key = _rand_keys(110, 1)
+        keys2 = jnp.tile(key, (2, 1))
+        regs = jnp.asarray([1, 2], jnp.int32)
+        store, _ = listen_at(swarm, cfg, store, scfg, keys2, regs,
+                             jax.random.PRNGKey(111), now=0)
+        # Within TTL: both deliver.
+        store, _ = announce(swarm, cfg, store, scfg, key,
+                            jnp.asarray([5], jnp.uint32),
+                            jnp.asarray([1], jnp.uint32), 50,
+                            jax.random.PRNGKey(112))
+        n = np.asarray(store.notified)
+        assert bool(n[1]) and bool(n[2])
+        # Refresh only listener 2; past the original expiry only it
+        # fires.
+        active = jnp.zeros((64,), bool).at[2].set(True)
+        store = refresh_listeners(store, scfg, active, 90)
+        store = ack_listeners(store, regs)
+        store, _ = announce(swarm, cfg, store, scfg, key,
+                            jnp.asarray([6], jnp.uint32),
+                            jnp.asarray([2], jnp.uint32), 150,
+                            jax.random.PRNGKey(113))
+        n = np.asarray(store.notified)
+        assert not bool(n[1]), "expired listener still delivered"
+        assert bool(n[2]), "refreshed listener lapsed"
+        # The reclaim sweep frees the lapsed rows for new listeners.
+        before = int((np.asarray(store.lids) >= 0).sum())
+        store = expire_listeners(store, scfg, 150)
+        after = int((np.asarray(store.lids) >= 0).sum())
+        assert after < before, "expire_listeners reclaimed nothing"
+
+    def test_refresh_noop_without_ttl(self, small_swarm):
+        """listen_ttl=0 = permanent registrations; refresh is a no-op
+        and nothing ever lapses."""
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        key = _rand_keys(120, 1)
+        store, _ = listen_at(swarm, cfg, store, SCFG, key,
+                             jnp.asarray([9], jnp.int32),
+                             jax.random.PRNGKey(121))
+        store = refresh_listeners(
+            store, SCFG, jnp.zeros((SCFG.max_listeners,), bool), 10)
+        store = expire_listeners(store, SCFG, 1 << 30)
+        store, _ = announce(swarm, cfg, store, SCFG, key,
+                            jnp.asarray([4], jnp.uint32),
+                            jnp.asarray([1], jnp.uint32), 1 << 30,
+                            jax.random.PRNGKey(122))
+        assert bool(store.notified[9])
+
+
+class TestChaosSurvival:
+    """Fault injection on the storage path, symmetric to the lookup
+    path's churn(): exchange loss + mass death + maintenance."""
+
+    def test_drop_frac_costs_replicas_never_correctness(self,
+                                                        small_swarm):
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        p = 64
+        keys = _rand_keys(130, p)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((p,), jnp.uint32)
+        store, rep = announce(swarm, cfg, store, SCFG, keys, vals, seqs,
+                              0, jax.random.PRNGKey(131),
+                              drop_frac=0.5,
+                              drop_key=jax.random.PRNGKey(132))
+        reps = np.asarray(rep.replicas)
+        assert 0 < reps.mean() < 6, reps.mean()   # lossy, not dead
+        res = get_values(swarm, cfg, store, SCFG, keys,
+                         jax.random.PRNGKey(133))
+        hit = np.asarray(res.hit)
+        assert hit.mean() > 0.9    # a couple of replicas suffice
+        assert (np.asarray(res.val)[hit] == np.asarray(vals)[hit]).all()
+
+    def test_survival_bound_after_mass_kill_one_sweep(self, small_swarm):
+        """The satellite chaos test: kill kill_frac of the storing
+        nodes, run ONE maintenance sweep (under exchange loss), and
+        survival must stay above a stated bound — with listener
+        continuity through it."""
+        swarm, cfg = small_swarm
+        kill_frac = 0.5
+        store = empty_store(cfg.n_nodes, SCFG)
+        p = 128
+        keys = _rand_keys(140, p)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((p,), jnp.uint32)
+        store, _ = announce(swarm, cfg, store, SCFG, keys, vals, seqs,
+                            0, jax.random.PRNGKey(141))
+        regs = jnp.arange(p, dtype=jnp.int32)
+        store, _ = listen_at(swarm, cfg, store, SCFG, keys, regs,
+                             jax.random.PRNGKey(142))
+        store = ack_listeners(store, regs)
+        dead = churn(swarm, jax.random.PRNGKey(143), kill_frac, cfg)
+        dead = heal_swarm(dead, cfg, jax.random.PRNGKey(144))
+        all_idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+        store, _ = republish_from(dead, cfg, store, SCFG, all_idx, 1,
+                                  jax.random.PRNGKey(145),
+                                  drop_frac=0.15,
+                                  drop_key=jax.random.PRNGKey(146))
+        res = get_values(dead, cfg, store, SCFG, keys,
+                         jax.random.PRNGKey(147))
+        hit = np.asarray(res.hit)
+        # Stated bound: killing half the swarm + 15 % exchange loss +
+        # one sweep must keep ≥ 95 % of values alive (theory ≈ 1 -
+        # kill_frac^quorum ≈ 0.996 before loss).
+        assert hit.mean() >= 0.95, hit.mean()
+        assert (np.asarray(res.val)[hit] == np.asarray(vals)[hit]).all()
+        # Listener continuity: the sweep's re-announces re-delivered
+        # to the (acked) listeners.
+        notified = np.asarray(store.notified)[:p]
+        assert notified.mean() > 0.9, notified.mean()
+
+    def test_heal_swarm_restores_lookup_recall(self, small_swarm):
+        """Bucket maintenance after churn: stale tables starve the
+        frontier at heavy cumulative death; healed tables restore
+        near-perfect recall of the true alive-closest."""
+        from opendht_tpu.models.swarm import lookup, lookup_recall
+
+        swarm, cfg = small_swarm
+        dead = swarm
+        for c in range(2):
+            dead = churn(dead, jax.random.PRNGKey(150 + c), 0.5, cfg)
+        targets = _rand_keys(152, 128)
+        stale = lookup(dead, cfg, targets, jax.random.PRNGKey(153))
+        r_stale = float(np.asarray(
+            lookup_recall(dead, cfg, stale, targets)).mean())
+        healed = heal_swarm(dead, cfg, jax.random.PRNGKey(154))
+        res = lookup(healed, cfg, targets, jax.random.PRNGKey(155))
+        r_healed = float(np.asarray(
+            lookup_recall(healed, cfg, res, targets)).mean())
+        assert r_healed > 0.95, (r_stale, r_healed)
+        assert r_healed > r_stale, (r_stale, r_healed)
+
+
+def test_store_geometry_over_int32_raises():
+    """A config whose flat element indices would overflow int32 must
+    fail loudly at construction (it used to wrap indices and silently
+    drop writes — ADVICE round 5)."""
+    # keys store: (2^26+1)*8*5 ≈ 2.7e9 ≥ 2^31
+    with pytest.raises(ValueError, match="int32"):
+        empty_store(1 << 26, StoreConfig(slots=8, listen_slots=2,
+                                         max_listeners=64))
+    # payload store overflow at the ADVICE repro shape (10M, slots=4,
+    # payload_words=64)
+    with pytest.raises(ValueError, match="payload"):
+        empty_store(10_000_000, StoreConfig(slots=4, listen_slots=2,
+                                            max_listeners=64,
+                                            payload_words=64))
+    # in-bounds configs still construct
+    empty_store(256, StoreConfig(slots=4, listen_slots=2,
+                                 max_listeners=64, payload_words=4))
+
+
 class TestExpireRepublish:
     def test_expire_ttl(self, small_swarm):
         swarm, cfg = small_swarm
@@ -415,6 +647,39 @@ class TestChunkedValues:
         got = np.asarray(res.payload)[hit]
         want = np.asarray(pls).reshape(p, parts * w)[hit]
         assert (got == want).all()
+
+    def test_zero_length_value_roundtrips(self, small_swarm):
+        """The reference permits empty value data; a zero-length
+        chunked value must announce (part 0 stored), read back as a
+        hit with length 0 and all-zero payload — not silently vanish
+        (ADVICE round 5)."""
+        from opendht_tpu.models.chunked_values import (
+            announce_chunked, get_chunked,
+        )
+
+        swarm, cfg = small_swarm
+        scfg = StoreConfig(slots=16, listen_slots=2, max_listeners=64,
+                           payload_words=4)
+        store = empty_store(cfg.n_nodes, scfg)
+        p, parts, w = 8, 2, 4
+        keys = _rand_keys(65, p)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((p,), jnp.uint32)
+        pls = jax.random.bits(jax.random.PRNGKey(66), (p, parts, w),
+                              jnp.uint32)
+        lens = jnp.zeros((p,), jnp.uint32)      # ALL values empty
+        store, rep = announce_chunked(swarm, cfg, store, scfg, keys,
+                                      vals, seqs, 0,
+                                      jax.random.PRNGKey(67), pls, lens)
+        assert float(np.asarray(rep.replicas).mean()) > 6, \
+            "zero-length values were silently un-announced"
+        res = get_chunked(swarm, cfg, store, scfg, keys,
+                          jax.random.PRNGKey(68), parts)
+        hit = np.asarray(res.hit)
+        assert hit.mean() > 0.95, hit.mean()
+        assert (np.asarray(res.length)[hit] == 0).all()
+        assert (np.asarray(res.val)[hit] == np.asarray(vals)[hit]).all()
+        assert (np.asarray(res.payload)[hit] == 0).all()
 
     def test_torn_update_reads_as_missing_not_garbled(self):
         """A fresher part-0 without its sibling part must fail the
