@@ -22,13 +22,16 @@ bench:
 # bench.py's EXACT code path (incl. the recall kernel config) at sizes
 # that finish in ~a minute.  A red gate means do not snapshot: rounds
 # 1 and 2 shipped rc=1 benches precisely because nothing ran this
-# before handing the repo to the driver.  The chaos leg exercises
-# fault injection (mid-republish mass death + exchange loss + the
-# listener lifecycle) on every PR, not just when someone remembers.
+# before handing the repo to the driver.  The chaos legs exercise
+# fault injection on every PR, not just when someone remembers:
+# storage (mid-republish mass death + exchange loss + the listener
+# lifecycle) and lookup (Byzantine responders + reply loss + the
+# strike/blacklist defense, defended vs undefended).
 gate: test
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256
 	python bench.py --mode chaos --nodes 16384 --puts 2048
+	python bench.py --mode chaos-lookup --nodes 16384 --lookups 4096 --recall-sample 256
 
 clean:
 	rm -f opendht_tpu/native/libdhtcore-*.so

@@ -88,13 +88,25 @@ def main():
     ap.add_argument("--recall-sample", type=int, default=512)
     ap.add_argument("--mode",
                     choices=("lookups", "putget", "churn", "crawl",
-                             "sharded", "hotshard", "repub", "chaos"),
+                             "sharded", "hotshard", "repub", "chaos",
+                             "chaos-lookup"),
                     default="lookups")
-    ap.add_argument("--kill-frac", type=float, default=0.5,
-                    help="fraction of nodes killed in --mode churn/chaos")
+    ap.add_argument("--kill-frac", type=float, default=None,
+                    help="fraction of nodes killed (churn/chaos: 0.5; "
+                         "chaos-lookup: 0.10)")
     ap.add_argument("--drop-frac", type=float, default=0.15,
                     help="chaos mode: fraction of announce/probe "
-                         "exchanges lost per maintenance sweep")
+                         "exchanges lost per maintenance sweep; "
+                         "chaos-lookup mode: fraction of lookup "
+                         "solicitation replies lost in transit")
+    ap.add_argument("--byzantine-frac", type=float, default=0.05,
+                    help="chaos-lookup mode: fraction of nodes that "
+                         "answer with poisoned closest-node windows")
+    ap.add_argument("--poison", choices=("random", "eclipse"),
+                    default="random",
+                    help="chaos-lookup mode: Byzantine poison shape — "
+                         "random node ids claimed near-zero, or "
+                         "colluder-promotion eclipse")
     ap.add_argument("--zipf", type=float, default=0.0,
                     help="churn mode: draw gets Zipf(s)-skewed over "
                          "the put keyset (0 = uniform, one get/key); "
@@ -126,11 +138,28 @@ def main():
                          "rule) on a 1-device mesh")
     args = ap.parse_args()
 
+    # Fault fractions are probabilities: reject out-of-range values
+    # LOUDLY at the CLI boundary.  (jax.random.uniform comparisons
+    # against e.g. kill_frac=1.5 or -0.2 silently behave like 1.0/0.0,
+    # and a bench that "ran fine" on a nonsense fault schedule is a
+    # lie in the artifact record.)
+    for frac_name in ("kill_frac", "drop_frac", "byzantine_frac"):
+        v = getattr(args, frac_name)
+        if v is not None and not 0.0 <= v <= 1.0:
+            ap.error(f"--{frac_name.replace('_', '-')} must be a "
+                     f"fraction in [0, 1], got {v}")
+
+    if args.kill_frac is None:
+        args.kill_frac = {"chaos-lookup": 0.10}.get(args.mode, 0.5)
     if args.nodes is None:
         args.nodes = {"churn": 100_000, "sharded": 1_000_000,
                       "hotshard": 1_000_000,
                       "repub": 65_536,
-                      "chaos": 65_536}.get(args.mode, 10_000_000)
+                      "chaos": 65_536,
+                      "chaos-lookup": 1_000_000}.get(args.mode,
+                                                     10_000_000)
+    if args.mode == "chaos-lookup":
+        return chaos_lookup_main(args)
     if args.mode == "putget":
         return putget_main(args)
     if args.mode == "churn":
@@ -1055,6 +1084,126 @@ def chaos_main(args):
         "listen_canceled_leak_rate": round(canceled_leak, 4),
         "listen_active_third_rate": round(active_third_rate, 4),
         "sim_fidelity": "payload-chunks",
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+
+
+def chaos_lookup_main(args):
+    """Adversarial LOOKUP survival: the routing half's chaos leg.
+
+    PR 1's --mode chaos proved the storage path degrades gracefully;
+    this leg proves the same for the lookup path under the fault model
+    storage never had — Byzantine responders (``--byzantine-frac`` of
+    nodes answer with poisoned closest-node windows, random or
+    eclipse-targeted per ``--poison``) on top of mass death
+    (``--kill-frac``, with bucket maintenance healing the tables — the
+    storage chaos leg's convention) and in-transit reply loss
+    (``--drop-frac``).  S/Kademlia's point (PAPERS.md): lookup
+    correctness under adversarial RESPONDERS, not just node loss, is
+    what a production DHT must prove.
+
+    Publishes one JSON row with a degradation CURVE across the
+    (kill × byzantine × drop) grid — recall@8 / done_frac /
+    median_hops per leg, all against the clean-swarm reference — plus
+    the defended-vs-undefended headline pair and the defense's
+    conviction precision/recall (strike/blacklist state,
+    models/swarm.py chaos_step_impl).  Recall is measured against the
+    true 8 closest HONEST alive nodes: convicted liars are excluded by
+    design, exactly like the host engine refusing blacklisted peers.
+    """
+    from opendht_tpu.models.swarm import (
+        LookupFaults, LookupResult, SwarmConfig, build_swarm,
+        chaos_lookup, churn, corrupt_swarm, heal_swarm, honest_recall,
+    )
+
+    kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    cfg = SwarmConfig.for_nodes(args.nodes, **kw)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    _ = np.asarray(swarm.tables[:1, :1])
+    targets = jax.random.bits(jax.random.PRNGKey(1),
+                              (args.lookups, 5), jnp.uint32)
+    kf, bf, df = args.kill_frac, args.byzantine_frac, args.drop_frac
+    eclipse = args.poison == "eclipse"
+
+    # Kill+heal once per distinct kill fraction (heal_swarm DONATES
+    # its table buffer, so the healed swarm gets its own copy and the
+    # clean base stays valid for the other grid legs).
+    healed = None
+    if kf:
+        healed = churn(swarm._replace(tables=jnp.copy(swarm.tables)),
+                       jax.random.PRNGKey(2), kf, cfg)
+        healed = heal_swarm(healed, cfg, jax.random.PRNGKey(3))
+
+    def leg(kill, byz, drop, defend=True):
+        sw = healed if kill else swarm
+        if byz:
+            sw = corrupt_swarm(sw, jax.random.PRNGKey(4), byz, cfg)
+        faults = LookupFaults(drop_frac=drop, eclipse=eclipse, seed=11,
+                              defend=defend)
+        t0 = time.perf_counter()
+        res, strikes = chaos_lookup(sw, cfg, targets,
+                                    jax.random.PRNGKey(5), faults)
+        _ = int(np.asarray(jnp.sum(res.found[:, 0])))   # completion
+        dt = time.perf_counter() - t0
+        # Recall vs the true 8 closest honest alive nodes, sampled.
+        m = min(args.recall_sample, args.lookups)
+        sample = LookupResult(found=res.found[:m], hops=res.hops[:m],
+                              done=res.done[:m])
+        recall = float(jnp.mean(honest_recall(sw, cfg, sample,
+                                              targets[:m])))
+        row = {"kill_frac": kill, "byzantine_frac": byz,
+               "drop_frac": drop, "defend": defend,
+               "recall_at_8": round(recall, 4),
+               "done_frac": float(np.asarray(res.done).mean()),
+               "median_hops": float(np.median(np.asarray(res.hops))),
+               "wall_s": round(dt, 3)}
+        if byz and defend:
+            # Conviction stats only exist where the defense ran —
+            # undefended legs never update strike state — and only
+            # ALIVE nodes are in scope: dead ones are never solicited,
+            # so they can neither offend nor be convicted and would
+            # only dilute the denominators by ~kill_frac.
+            conv = np.asarray(strikes) >= faults.strike_limit
+            byz_m = np.asarray(sw.byzantine)
+            alive_m = np.asarray(sw.alive)
+            row["convicted_byzantine_frac"] = round(
+                float(conv[byz_m & alive_m].mean()), 4)
+            row["convicted_honest_frac"] = round(
+                float(conv[~byz_m & alive_m].mean()), 6)
+        return row
+
+    curve = [leg(0.0, 0.0, 0.0),
+             leg(kf, 0.0, 0.0),
+             leg(0.0, bf, 0.0),
+             leg(0.0, 0.0, df)]
+    headline = leg(kf, bf, df)
+    undefended = leg(kf, bf, df, defend=False)
+    clean = curve[0]
+
+    out = {
+        "metric": "swarm_chaos_lookup_recall_at_8",
+        "value": headline["recall_at_8"],
+        "unit": "fraction",
+        "vs_baseline": round(headline["recall_at_8"]
+                             / max(clean["recall_at_8"], 1e-9), 4),
+        "baseline_note": "vs_baseline = survival ratio vs the clean-"
+                         "swarm leg of the same grid (1.0 = adversarial"
+                         " conditions cost nothing)",
+        "n_nodes": cfg.n_nodes,
+        "n_lookups": args.lookups,
+        "kill_frac": kf,
+        "byzantine_frac": bf,
+        "drop_frac": df,
+        "poison": args.poison,
+        "headline": headline,
+        "undefended": undefended,
+        "degradation_curve": curve,
+        "defense": {"strike_limit": LookupFaults().strike_limit,
+                    "undefended_recall": undefended["recall_at_8"],
+                    "defense_recall_gain": round(
+                        headline["recall_at_8"]
+                        - undefended["recall_at_8"], 4)},
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(out))

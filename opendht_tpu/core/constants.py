@@ -21,6 +21,12 @@ SEARCH_MAX_BAD_NODES = 25     # consecutive expired nodes => connectivity loss
 # --- network engine --------------------------------------------------------
 MAX_RESPONSE_TIME = 1.0       # seconds per request attempt
 MAX_ATTEMPT_COUNT = 3         # retransmits before EXPIRED
+BLACKLIST_EXPIRE_TIME = 10 * 60  # misbehaving peers sit out 10 min
+# The blacklist is a bounded set of misbehaving peers (SURVEY §4: "LRU
+# of misbehaving peers") — a cap keeps an attacker cycling source
+# addresses from growing it without bound; soonest-to-expire entries
+# are evicted first when full.
+MAX_BLACKLIST_SIZE = 1024
 MAX_REQUESTS_PER_SEC = 1600   # global inbound rate limit
 MAX_REQUESTS_PER_SEC_PER_IP = 200
 MAX_PACKET_VALUE_SIZE = 8 * 1024   # larger values are fragmented

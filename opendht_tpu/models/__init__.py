@@ -1,13 +1,17 @@
 """Swarm-scale models: the TPU-resident Kademlia simulation engine."""
 
 from .swarm import (  # noqa: F401
+    LookupFaults,
     LookupResult,
     LookupState,
     Swarm,
     SwarmConfig,
     build_swarm,
+    chaos_lookup,
     churn,
+    corrupt_swarm,
     heal_swarm,
+    honest_recall,
     lookup,
     lookup_init,
     lookup_recall,

@@ -167,6 +167,32 @@ class SwarmConfig(NamedTuple):
         return cls(n_nodes=n_nodes, n_buckets=b, **kw)
 
 
+# Geometry invariant, enforced at CONFIG BUILD time (wrapping the
+# generated NamedTuple __new__; typing.NamedTuple forbids defining one
+# in the class body): ``_finalize`` exact-sorts only the top
+# ``quorum + 2`` surrogate ranks, so the two-slot margin that bounds
+# its order error (BASELINE.md sim_fidelity) only exists when the
+# shortlist is at least that wide.  A config violating it would
+# silently report fewer than ``quorum`` results from a shrunken head
+# instead of failing loudly here.  (``_replace`` bypasses __new__ via
+# ``_make``; the entry points all construct configs directly.)
+_swarmconfig_new = SwarmConfig.__new__
+
+
+def _swarmconfig_checked_new(cls, *args, **kw):
+    cfg = _swarmconfig_new(cls, *args, **kw)
+    if cfg.quorum + 2 > cfg.search_width:
+        raise ValueError(
+            f"SwarmConfig requires quorum + 2 <= search_width (the "
+            f"_finalize exact re-sort covers the top quorum+2 surrogate "
+            f"ranks — see BASELINE.md sim_fidelity); got quorum="
+            f"{cfg.quorum}, search_width={cfg.search_width}")
+    return cfg
+
+
+SwarmConfig.__new__ = _swarmconfig_checked_new
+
+
 class Swarm(NamedTuple):
     """Device-resident swarm state (a pytree of arrays).
 
@@ -200,6 +226,14 @@ class Swarm(NamedTuple):
     tables: jax.Array  # [N, pad128(B*3K)] u16 (augmented) or
     #                    [N, B*K] i32 (plain) — see class docstring
     alive: jax.Array   # [N] bool
+    # Byzantine responder mask (None = honest swarm, the default —
+    # existing pytrees/programs are unchanged).  Members stay alive and
+    # answer solicitations, but with POISONED closest-node windows (see
+    # :func:`chaos_step_impl`): the adversarial model of S/Kademlia
+    # (Baumgart & Mies 2007, PAPERS.md), where lookup failure comes
+    # from nodes that answer *wrongly*, not from node loss.  Only the
+    # chaos lookup path reads it; `lookup()` ignores it entirely.
+    byzantine: jax.Array | None = None   # [N] bool
 
 
 class LookupState(NamedTuple):
@@ -403,6 +437,24 @@ def churn(swarm: Swarm, key: jax.Array, kill_frac: float,
     """
     keep = jax.random.uniform(key, (cfg.n_nodes,)) >= kill_frac
     return swarm._replace(alive=swarm.alive & keep)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def corrupt_swarm(swarm: Swarm, key: jax.Array, byzantine_frac: float,
+                  cfg: SwarmConfig) -> Swarm:
+    """Mark a uniform fraction of nodes Byzantine — the adversarial
+    twin of :func:`churn`.
+
+    Byzantine members stay alive (a dead attacker is just churn) and
+    keep answering, but their ``_respond`` windows are poisoned by the
+    chaos step (:func:`chaos_step_impl`): random node ids advertised at
+    near-zero distance, or eclipse-style self-promotion.  The plain
+    :func:`lookup` path ignores the mask entirely — adversarial
+    behavior is opt-in per run, like the storage path's
+    ``drop_exchanges``.
+    """
+    byz = jax.random.uniform(key, (cfg.n_nodes,)) < byzantine_frac
+    return swarm._replace(byzantine=byz)
 
 
 def heal_swarm(swarm: Swarm, cfg: SwarmConfig, key: jax.Array) -> Swarm:
@@ -700,13 +752,28 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     sel = jnp.where(st.done[:, None], -1, sel)
     sel_alive = (sel >= 0) & alive[jnp.clip(sel, 0, cfg.n_nodes - 1)]
     resp, resp_d0, answered = respond(st.targets, sel, sel_d0)  # [L,A*2K]
+    return _merge_round(st, cfg, sel, sel_alive, answered, resp,
+                        resp_d0)
+
+
+def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
+                 sel_alive: jax.Array, answered: jax.Array,
+                 resp: jax.Array, resp_d0: jax.Array) -> LookupState:
+    """Round tail shared by the plain and chaos engines: fold the α
+    solicitations' outcomes into the shortlist, merge, re-sort, check
+    the sync quorum.  ONE copy of the merge/eviction/done semantics,
+    so the two engines cannot silently diverge.
+
+    Answered solicitations become "queried"; nodes in ``sel`` marked
+    not ``sel_alive`` (dead — or, on the chaos path, convicted /
+    contradicted) are evicted from the shortlist entirely — the
+    reference expires a node after 3 unanswered attempts and replaces
+    it with the next candidate (request.h:113, src/dht.cpp:1059-1074).
+    Alive-but-unanswered (transport drop) stays unqueried and is
+    re-solicited next round.
+    """
     hit = st.idx[:, :, None] == sel[:, None, :]                 # [L,S,A]
     hit = hit & (sel[:, None, :] >= 0)
-    # Answered solicitations become "queried"; dead nodes are evicted
-    # from the shortlist entirely — the reference expires a node after
-    # 3 unanswered attempts and replaces it with the next candidate
-    # (request.h:113, src/dht.cpp:1059-1074).  Alive-but-unanswered
-    # (transport drop) stays unqueried and is re-solicited next round.
     queried = st.queried | jnp.any(
         hit & (sel_alive & answered)[:, None, :], axis=2)
     evict = jnp.any(hit & (~sel_alive & (sel >= 0))[:, None, :], axis=2)
@@ -817,7 +884,8 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     # Origins are drawn from *alive* nodes: the issuing node exists.
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
-    st = run_burst_loop(lambda s: lookup_step(swarm, cfg, s), st, cfg)
+    st = run_burst_loop(lambda s, r: lookup_step(swarm, cfg, s), st,
+                        cfg)
     # (A tail-compaction variant — argsort the active minority into a
     # quarter-width sub-batch after the burst — measured SLOWER at 10M:
     # 334.8k vs 357.6k lookups/s; the sort/gather/scatter and the extra
@@ -844,24 +912,31 @@ def burst_schedule(cfg: SwarmConfig) -> int:
                max(6, math.ceil(math.log2(max(2, cfg.n_nodes)) / 2.65)))
 
 
-def run_burst_loop(step_fn, st: LookupState,
-                   cfg: SwarmConfig) -> LookupState:
+def run_burst_loop(step_fn, state, cfg: SwarmConfig,
+                   done_of=lambda s: s.done):
     """Host-driven round loop: dispatch ``burst_schedule`` rounds
     back-to-back (they pipeline on the device), then check global
     done-ness with one scalar readback, topping up 2 rounds at a time.
     Finished lookups are frozen inside the step, so overshoot is
-    wall-clock waste only, never a semantics change."""
+    wall-clock waste only, never a semantics change.
+
+    ``step_fn(state, round)`` advances an opaque carry one round (the
+    round index doubles as the chaos engine's stateless fault-stream
+    coordinate); ``done_of`` extracts the ``[L]`` done mask from the
+    carry.  One loop serves the plain engines (carry = LookupState)
+    and the chaos engine (carry = (LookupState, strikes)) — burst
+    policy tuning lands in exactly one place."""
     burst = burst_schedule(cfg)
     rounds = 0
     while rounds < cfg.max_steps:
         n = min(burst, cfg.max_steps - rounds)
         for _ in range(n):
-            st = step_fn(st)
-        rounds += n
-        if bool(jnp.all(st.done)):
+            state = step_fn(state, rounds)
+            rounds += 1
+        if bool(jnp.all(done_of(state))):
             break
         burst = 2
-    return st
+    return state
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_steps"))
@@ -912,10 +987,349 @@ def true_closest(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
 
 
 def lookup_recall(swarm: Swarm, cfg: SwarmConfig, result: LookupResult,
-                  targets: jax.Array, k: int = 8) -> jax.Array:
-    """Fraction of the true k closest alive nodes found, per lookup."""
-    truth = true_closest(swarm, cfg, targets, k)                # [L,k]
+                  targets: jax.Array, k: int = 8,
+                  valid: jax.Array | None = None) -> jax.Array:
+    """Fraction of the true k closest alive nodes found, per lookup.
+
+    ``valid`` overrides the ground-truth membership mask (default
+    ``swarm.alive``): adversarial scenarios pass ``alive & ~byzantine``
+    so recall measures finding the true HONEST closest — convicted
+    liars are excluded by design, like host-blacklisted peers.
+    """
+    sw = swarm if valid is None else swarm._replace(alive=valid)
+    truth = true_closest(sw, cfg, targets, k)                   # [L,k]
     found = result.found                                        # [L,q]
     match = (truth[:, :, None] == found[:, None, :]) & (
         truth[:, :, None] >= 0)
     return jnp.any(match, axis=2).mean(axis=1)
+
+
+def honest_recall(swarm: Swarm, cfg: SwarmConfig, result: LookupResult,
+                  targets: jax.Array, k: int = 8) -> jax.Array:
+    """:func:`lookup_recall` against the honest alive ground truth
+    (``alive & ~byzantine``) — the survival metric of the adversarial
+    bench and tests."""
+    valid = (swarm.alive if swarm.byzantine is None
+             else swarm.alive & ~swarm.byzantine)
+    return lookup_recall(swarm, cfg, result, targets, k, valid=valid)
+
+
+# ---------------------------------------------------------------------------
+# adversarial lookups: Byzantine faults + device strike/blacklist state
+# ---------------------------------------------------------------------------
+
+class LookupFaults(NamedTuple):
+    """Static fault + defense model for the adversarial lookup path
+    (Python scalars — part of the jit cache key, like ``SwarmConfig``).
+
+    PR 1 gave the *storage* path its chaos knobs (``drop_exchanges``,
+    mid-republish kills); this is the lookup twin plus the layer
+    neither path had: nodes that answer *wrongly* rather than not at
+    all — the S/Kademlia adversarial-responder model (Baumgart & Mies
+    2007; see PAPERS.md), which is what lookup correctness must
+    actually be proved against.
+
+    * ``drop_frac`` — fraction of solicitation replies lost in transit
+      (counter-hash Bernoulli per (node, target, round)); the origin
+      keeps the entry unqueried and re-solicits next round, the
+      lock-step analogue of the reference's 1 s retransmit
+      (request.h:113) and the symmetric twin of the storage path's
+      ``drop_exchanges``.
+    * ``eclipse`` — poison shape of Byzantine responders
+      (``Swarm.byzantine``): False = random node ids advertised at
+      near-zero claimed distance (shortlist flooding); True =
+      COLLUDER PROMOTION — every poisoned slot names a fellow
+      Byzantine node claimed near zero, so a captured frontier keeps
+      soliciting (and being fed by) the attacker set.
+    * ``seed`` — the stateless fault stream (runs are reproducible per
+      seed; no key threads through the lock-step loop).
+    * ``strike_limit`` — strikes before device blacklist, the twin of
+      the reference's 3-attempt expiry (request.h:113) feeding
+      ``blacklist_node`` (net/network_engine.py).
+    * ``defend`` — False disables verification/conviction entirely and
+      measures the UNDEFENDED damage (the bench's reference rows).
+    """
+    drop_frac: float = 0.0
+    eclipse: bool = False
+    seed: int = 0
+    strike_limit: int = 3
+    defend: bool = True
+
+
+def _fault_hash(x: jax.Array, y: jax.Array, rnd: jax.Array,
+                seed: int) -> jax.Array:
+    """Stateless per-exchange uint32 hash (murmur-style finalizer) —
+    the chaos path's counter-based RNG.  Deterministic per
+    (x, y, round, seed), so fault schedules replay exactly without
+    threading PRNG keys through the lock-step loop state."""
+    h = (x.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ y.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+         ^ (rnd.astype(jnp.uint32) + jnp.uint32(seed & 0xFFFFFFFF))
+         * jnp.uint32(0xC2B2AE35))
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> jnp.uint32(15))
+    h = h * jnp.uint32(0x846CA68B)
+    return h ^ (h >> jnp.uint32(16))
+
+
+def byz_colluder_pool(byzantine: jax.Array):
+    """Precompute the eclipse poison's colluder index pool: a stable
+    argsort compacts the mask's True indices to the front, and the
+    count clamps to ≥1 (unused when no responder is Byzantine).  The
+    pool is constant for a whole chaos run — callers compute it ONCE
+    and pass it to every round, keeping the [N] sort off the per-round
+    path."""
+    pool = jnp.argsort(~byzantine).astype(jnp.int32)
+    n_byz = jnp.maximum(jnp.sum(byzantine.astype(jnp.int32)),
+                        1).astype(jnp.uint32)
+    return pool, n_byz
+
+
+def chaos_step_impl(ids: jax.Array, alive: jax.Array,
+                    byzantine: jax.Array | None, respond,
+                    cfg: SwarmConfig, faults: LookupFaults,
+                    st: LookupState, strikes: jax.Array,
+                    rnd: jax.Array, allreduce=None, byz_aux=None):
+    """One adversarial lock-step round: :func:`step_impl` plus the
+    Byzantine fault model and the strike/blacklist defense.
+
+    Fault injection per round:
+    * Byzantine responders (``byzantine`` mask) answer with POISONED
+      windows — every candidate slot replaced per ``faults.eclipse``
+      with a random node id claimed at a near-zero distance, or a
+      fellow-attacker id claimed near zero (colluders capturing the
+      shortlist head and, once solicited, feeding back more
+      colluders);
+    * a ``faults.drop_frac`` Bernoulli of replies is lost in transit
+      (entry stays unqueried, re-solicited next round).
+
+    Why the attack has power here at all: the reference ships full
+    node IDs and the receiver computes distances itself, so a liar is
+    limited to advertising useless-or-fake ids that later time out.
+    The aug-table engine ships 16-bit distance *claims* for speed
+    (:func:`_window_d0`), so a Byzantine responder can also lie about
+    placement — strictly nastier.
+
+    Defense (``faults.defend``), the device twin of the host engine's
+    request-lifecycle robustness (net/network_engine.py
+    ``_request_step``/``blacklist_node``):
+    * every incoming candidate's CLAIMED distance is verified against
+      the exact first limb before it may merge (one ``[L, α·2K]``
+      limb-0 gather per round — the price of not trusting windows;
+      honest reconstructions are exact through at least the top 16
+      bits, so a top-16 mismatch is PROOF of a poisoned reply).
+      Contradicted candidates never enter the shortlist and the
+      responder whose reply carried them takes a strike per poisoned
+      exchange;
+    * replies the fault model lost take a strike on the silent node
+      (the origin counts it like the reference's unanswered attempt —
+      capacity drops of the sharded transport do NOT strike: the
+      origin itself shed those sends and retries them knowingly);
+    * a clean answer RESETS the responder's strikes (the reference's
+      ``node.received()`` clearing expiry) — under pure loss a node
+      needs ``strike_limit`` consecutive silent rounds to be
+      convicted, matching the 3-attempt expiry semantics;
+    * nodes at ``strikes >= strike_limit`` are blacklisted: evicted
+      from every shortlist at once, never solicited again, and their
+      ids rejected from incoming candidate windows — conviction is
+      mesh-wide, like ``blacklist_node`` cancelling every pending
+      request of a convicted node.
+
+    One round's strike events merge order-free (a clean answer
+    forgives that round's silence and resets the counter; poisoned-
+    reply proof always adds), so the sharded path gets identical
+    semantics from one stacked ``[3, N]`` all-reduce (``allreduce``).
+    Returns ``(new_state, new_strikes)``.
+    """
+    n = cfg.n_nodes
+    t0 = st.targets[:, 0]
+    defend = faults.defend
+    if defend:
+        blk = strikes >= faults.strike_limit
+        # Convicted nodes leave every shortlist at once (mesh-wide
+        # blacklist eviction).
+        conv = (st.idx >= 0) & blk[jnp.clip(st.idx, 0, n - 1)]
+        st = st._replace(
+            idx=jnp.where(conv, -1, st.idx),
+            dist=jnp.where(conv, jnp.uint32(UINT32_MAX), st.dist),
+            queried=st.queried & ~conv)
+
+    sel, sel_d0 = _select_alpha(st, cfg)
+    sel = jnp.where(st.done[:, None], -1, sel)
+    safe = jnp.clip(sel, 0, n - 1)
+    valid = sel >= 0
+
+    sel_alive = valid & alive[safe]
+    solicit = jnp.where(sel_alive, sel, -1)
+    resp, resp_d0, answered = respond(st.targets, solicit, sel_d0)
+
+    a = sel.shape[1]
+    k2 = resp.shape[1] // a
+    if byzantine is not None:
+        byz_sel = sel_alive & byzantine[safe] & answered
+        byz_rep = jnp.repeat(byz_sel, k2, axis=1)         # [L, A*2K]
+        slot = jnp.arange(a * k2, dtype=jnp.uint32)[None, :]
+        h = _fault_hash(
+            jnp.repeat(safe, k2, axis=1).astype(jnp.uint32)
+            + slot * jnp.uint32(7919),
+            t0[:, None], rnd, faults.seed ^ 0x517CC1B7)
+        if faults.eclipse:
+            # Colluder promotion: poisoned slots name OTHER Byzantine
+            # nodes, so captured frontiers keep feeding the attacker
+            # set.  The pool is run-constant — precomputed by the
+            # caller (byz_colluder_pool) so the [N] sort stays off the
+            # per-round path.
+            byz_pool, n_byz = (byz_aux if byz_aux is not None
+                               else byz_colluder_pool(byzantine))
+            p_idx = byz_pool[(h % n_byz).astype(jnp.int32)]
+        else:
+            p_idx = (h % jnp.uint32(n)).astype(jnp.int32)
+        # Claimed distance: near zero (top 17 bits clear) — the lie
+        # that heads every shortlist it touches.
+        p_d0 = _fault_hash(h, t0[:, None], rnd,
+                           faults.seed ^ 0x27220A95) >> jnp.uint32(17)
+        resp = jnp.where(byz_rep, p_idx, resp)
+        resp_d0 = jnp.where(byz_rep, p_d0, resp_d0)
+
+    if faults.drop_frac:
+        # Only exchanges that were actually DELIVERED can lose their
+        # reply in transit: ``answered`` is still the respond
+        # contract's delivery mask here, so capacity-shed sends (the
+        # sharded transport's bounded all_to_all) are excluded — the
+        # origin shed those itself and must not strike for them.
+        thresh = jnp.uint32(min(1.0, faults.drop_frac) * 4294967295.0)
+        dropm = sel_alive & answered & (_fault_hash(
+            safe.astype(jnp.uint32), t0[:, None], rnd,
+            faults.seed) <= thresh)
+        answered = answered & ~dropm
+        drop_rep = jnp.repeat(dropm, k2, axis=1)
+        resp = jnp.where(drop_rep, -1, resp)
+        resp_d0 = jnp.where(drop_rep, jnp.uint32(UINT32_MAX), resp_d0)
+    else:
+        dropm = jnp.zeros_like(valid)
+
+    if defend:
+        # Verify every candidate's claim against the exact first limb
+        # and reject convicted ids — poisoned entries never merge.
+        c_safe = jnp.clip(resp, 0, n - 1)
+        exact_d0 = ids[:, 0][c_safe] ^ t0[:, None]        # [L, A*2K]
+        contradicted = (resp >= 0) & (
+            (resp_d0 >> jnp.uint32(16)) != (exact_d0 >> jnp.uint32(16)))
+        bad_cand = contradicted | ((resp >= 0) & blk[c_safe])
+        resp = jnp.where(bad_cand, -1, resp)
+        resp_d0 = jnp.where(bad_cand, jnp.uint32(UINT32_MAX), resp_d0)
+        # A reply carrying any contradicted claim is a poisoned
+        # exchange, attributable to its responder.
+        malformed = jnp.any(contradicted.reshape(-1, a, k2), axis=2)
+    else:
+        malformed = jnp.zeros_like(valid)
+
+    # Shared round tail: dead solicitations evict via ~sel_alive;
+    # poisoned/blacklisted response slots were invalidated above, and
+    # convicted RESPONDERS leave shortlists at the next round's
+    # blacklist eviction (plus the final _censor_convicted pass).
+    new_st = _merge_round(st, cfg, sel, sel_alive, answered, resp,
+                          resp_d0)
+
+    # --- strike accounting (see the docstring's defense contract).
+    # Undefended runs skip it entirely: strikes would drive nothing,
+    # and the per-round [N] scatters (+ mesh all-reduces) are pure
+    # waste there.
+    if not defend:
+        return new_st, strikes
+    succ = sel_alive & answered & ~malformed
+    oob = jnp.int32(n)
+    succ_ct = jnp.zeros((n,), jnp.int32).at[
+        jnp.where(succ, sel, oob)].add(1, mode="drop")
+    drop_ct = jnp.zeros((n,), jnp.int32).at[
+        jnp.where(dropm, sel, oob)].add(1, mode="drop")
+    lie_ct = jnp.zeros((n,), jnp.int32).at[
+        jnp.where(malformed, sel, oob)].add(1, mode="drop")
+    if allreduce is not None:
+        cts = allreduce(jnp.stack([succ_ct, drop_ct, lie_ct]))
+        succ_ct, drop_ct, lie_ct = cts[0], cts[1], cts[2]
+    # Silence is circumstantial: a round with ANY clean answer proves
+    # liveness and forgives that round's drops along with the old
+    # count, and an all-silent round counts as ONE strike no matter
+    # how many lookups went unanswered (a node dark for one round must
+    # not be convicted outright by concurrent solicitations — strikes
+    # grow only across CONSECUTIVE all-silent rounds, the 3-attempt
+    # expiry semantics).  Poisoned replies are PROOF and always count
+    # per exchange.  Conviction is permanent for the lifetime of the
+    # batch — shorter than the host twin's 10-minute sentence; fresh
+    # batches start clean.
+    strikes = jnp.where(succ_ct > 0, 0,
+                        strikes + jnp.minimum(drop_ct, 1)) + lie_ct
+    return new_st, strikes
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def chaos_lookup_init(swarm: Swarm, cfg: SwarmConfig,
+                      targets: jax.Array,
+                      origins: jax.Array) -> LookupState:
+    # The seed exchange consults the origin's OWN routing table (the
+    # reference's search creation, src/dht.cpp:1672-1735): trusted, so
+    # no fault injection — matching the storage path's uncapped init.
+    return init_impl(swarm.ids, _local_respond(swarm, cfg), cfg,
+                     targets, origins)
+
+
+@partial(jax.jit, static_argnames=("cfg", "faults"))
+def chaos_lookup_step(swarm: Swarm, cfg: SwarmConfig,
+                      faults: LookupFaults, st: LookupState,
+                      strikes: jax.Array, rnd: jax.Array,
+                      byz_aux=None):
+    return chaos_step_impl(swarm.ids, swarm.alive, swarm.byzantine,
+                           _local_respond(swarm, cfg), cfg, faults,
+                           st, strikes, rnd, byz_aux=byz_aux)
+
+
+def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
+                 key: jax.Array,
+                 faults: LookupFaults = LookupFaults()
+                 ) -> tuple[LookupResult, jax.Array]:
+    """Run a batch of lookups to completion UNDER the adversarial
+    fault model (Byzantine responders + exchange loss) with the
+    strike/blacklist defense — the lookup-path twin of the storage
+    chaos harness.
+
+    Same host-driven burst loop as :func:`lookup` (the round counter
+    doubles as the stateless fault stream's round coordinate); origins
+    are drawn from honest alive nodes (the issuing node itself is not
+    the attacker).  Returns ``(LookupResult, strikes [N] int32)`` —
+    ``strikes >= faults.strike_limit`` is the conviction mask, which
+    benches report as true/false-conviction rates against
+    ``swarm.byzantine``.
+    """
+    l = targets.shape[0]
+    honest_alive = (swarm.alive if swarm.byzantine is None
+                    else swarm.alive & ~swarm.byzantine)
+    origins = _sample_origins(key, honest_alive, l)
+    st = chaos_lookup_init(swarm, cfg, targets, origins)
+    strikes = jnp.zeros((cfg.n_nodes,), jnp.int32)
+    byz_aux = (byz_colluder_pool(swarm.byzantine)
+               if faults.eclipse and swarm.byzantine is not None
+               else None)
+    st, strikes = run_burst_loop(
+        lambda c, r: chaos_lookup_step(swarm, cfg, faults, c[0], c[1],
+                                       jnp.int32(r), byz_aux),
+        (st, strikes), cfg, done_of=lambda c: c[0].done)
+    found = _finalize(swarm.ids, st, cfg)
+    found = _censor_convicted(found, strikes, cfg, faults)
+    return (LookupResult(found=found, hops=st.hops, done=st.done),
+            strikes)
+
+
+def _censor_convicted(found: jax.Array, strikes: jax.Array,
+                      cfg: SwarmConfig,
+                      faults: LookupFaults) -> jax.Array:
+    """Drop convicted nodes from reported results.  Blacklist eviction
+    runs at the START of each round, so a conviction landing in the
+    LAST executed round would otherwise survive in a done lookup's
+    head — the one gap in mesh-wide eviction."""
+    if not faults.defend:
+        return found
+    blk = strikes >= faults.strike_limit
+    hole = (found >= 0) & blk[jnp.clip(found, 0, cfg.n_nodes - 1)]
+    return jnp.where(hole, -1, found)

@@ -40,7 +40,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
-from ..core.constants import (MAX_PACKET_VALUE_SIZE, MAX_REQUESTS_PER_SEC,
+from ..core.constants import (BLACKLIST_EXPIRE_TIME, MAX_BLACKLIST_SIZE,
+                              MAX_PACKET_VALUE_SIZE, MAX_REQUESTS_PER_SEC,
                               MAX_REQUESTS_PER_SEC_PER_IP, MAX_RESPONSE_TIME,
                               MAX_MESSAGE_VALUE_COUNT, MTU, RX_MAX_PACKET_TIME,
                               RX_TIMEOUT)
@@ -355,7 +356,26 @@ class NetworkEngine:
             if req.node is node:
                 req.cancel()
                 del self.requests[tid]
-        self.blacklist[node.addr] = self.scheduler.time() + 10 * 60
+        self._purge_blacklist(self.scheduler.time())
+        self.blacklist[node.addr] = (self.scheduler.time()
+                                     + BLACKLIST_EXPIRE_TIME)
+
+    def _purge_blacklist(self, now: float) -> None:
+        """Blacklist hygiene: drop entries whose sentence is served
+        (`is_node_blacklisted` only reaps the addresses it is asked
+        about — addresses never heard from again would otherwise
+        accumulate forever), then enforce the size cap by evicting the
+        soonest-to-expire entries (they were convicted earliest; an
+        attacker cycling source addresses must not grow the map
+        without bound — SURVEY §4's bounded misbehaving-peer set)."""
+        for addr, until in list(self.blacklist.items()):
+            if until < now:
+                del self.blacklist[addr]
+        excess = len(self.blacklist) - (MAX_BLACKLIST_SIZE - 1)
+        if excess > 0:
+            for addr, _ in sorted(self.blacklist.items(),
+                                  key=lambda kv: kv[1])[:excess]:
+                del self.blacklist[addr]
 
     def is_node_blacklisted(self, addr: SockAddr) -> bool:
         until = self.blacklist.get(addr)

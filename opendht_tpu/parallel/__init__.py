@@ -1,4 +1,8 @@
 """Mesh sharding: data-parallel and table-sharded swarm lookups."""
 
 from .mesh import AXIS, batch_sharded, make_mesh, replicated  # noqa: F401
-from .sharded import data_parallel_lookup, sharded_lookup  # noqa: F401
+from .sharded import (  # noqa: F401
+    chaos_sharded_lookup,
+    data_parallel_lookup,
+    sharded_lookup,
+)

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.swarm import (
     LOOKUP_HEADROOM_BYTES,
+    LookupFaults,
     LookupResult,
     LookupState,
     Swarm,
@@ -42,8 +43,11 @@ from ..models.swarm import (
     _respond,
     _sample_origins,
     _select_alpha,
+    _censor_convicted,
     _select_pair_window,
     _unpack_pair_window,
+    byz_colluder_pool,
+    chaos_step_impl,
     device_hbm_bytes,
     init_impl,
     lookup,
@@ -425,11 +429,108 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     st = _sharded_lookup_init(swarm, cfg, targets, key, mesh,
                               capacity_factor, local_respond)
     st = run_burst_loop(
-        lambda s: _sharded_lookup_step(swarm, cfg, s, mesh,
-                                       capacity_factor, local_respond),
+        lambda s, r: _sharded_lookup_step(swarm, cfg, s, mesh,
+                                          capacity_factor,
+                                          local_respond),
         st, cfg)
     found = _finalize(swarm.ids, st, cfg)
     return LookupResult(found=found, hops=st.hops, done=st.done)
+
+
+# ---------------------------------------------------------------------------
+# adversarial lookups on the routed multi-chip path
+# ---------------------------------------------------------------------------
+
+def _chaos_sharded_body(cfg: SwarmConfig, n_shards: int,
+                        capacity_factor: float, faults: LookupFaults,
+                        ids, tables_local, alive, byzantine, targets,
+                        key):
+    """Per-device chaos lookup loop: the shared adversarial round
+    (``models.swarm.chaos_step_impl``) over the ROUTED respond.
+
+    Fault injection and the strike/blacklist defense live entirely in
+    the step wrapper, so the routed exchange needs no changes — poison
+    replaces a Byzantine responder's returned window after the
+    all_to_all brings it home, exactly where the local engine poisons
+    its gather.  Strike events are merged mesh-wide with two ``[N]``
+    psums per round (any-success-resets then accusations-add, an
+    order-free formula identical to the local engine's), so a node
+    convicted by lookups on one shard leaves shortlists on EVERY
+    shard the same round — the multi-chip form of
+    ``blacklist_node``'s global conviction.  Capacity drops of the
+    bounded all_to_all do NOT strike (the origin shed those sends
+    itself); only the fault model's in-transit losses do.
+    """
+    ll = targets.shape[0]
+    me = jax.lax.axis_index(AXIS)
+    key = jax.random.fold_in(key, me)
+    origins = _sample_origins(key, alive & ~byzantine, ll)
+    respond_init, respond = _make_responders(
+        cfg, n_shards, capacity_factor, False, ids, tables_local,
+        alive)
+    st = init_impl(ids, respond_init, cfg, targets, origins)
+    strikes = jnp.zeros((cfg.n_nodes,), jnp.int32)
+    allreduce = lambda x: jax.lax.psum(x, AXIS)
+    # Run-constant eclipse pool: hoisted out of the while-loop body so
+    # the [N] argsort runs once per program, not once per round.
+    byz_aux = (byz_colluder_pool(byzantine) if faults.eclipse
+               else None)
+
+    def cond(carry):
+        st, _, it = carry
+        pending = jax.lax.psum(jnp.sum(~st.done), AXIS)
+        return (pending > 0) & (it < cfg.max_steps)
+
+    def body(carry):
+        st, strikes, it = carry
+        st, strikes = chaos_step_impl(
+            ids, alive, byzantine, respond, cfg, faults, st, strikes,
+            it, allreduce=allreduce, byz_aux=byz_aux)
+        return st, strikes, it + 1
+
+    st, strikes, _ = jax.lax.while_loop(
+        cond, body, (st, strikes, jnp.int32(0)))
+    # Last-round convictions would otherwise survive in done heads
+    # (eviction runs at the start of the NEXT round, which the loop
+    # exit skips) — censor reported results like the local engine.
+    found = _censor_convicted(_finalize(ids, st, cfg), strikes, cfg,
+                              faults)
+    return found, st.hops, st.done, strikes
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "faults",
+                                   "capacity_factor"))
+def chaos_sharded_lookup(swarm: Swarm, cfg: SwarmConfig,
+                         targets: jax.Array, key: jax.Array, mesh: Mesh,
+                         faults: LookupFaults = LookupFaults(),
+                         capacity_factor: float = 2.0
+                         ) -> tuple[LookupResult, jax.Array]:
+    """Table-sharded adversarial lookups: :func:`sharded_lookup` under
+    the Byzantine fault model, with mesh-wide strike/blacklist state.
+
+    Tables shard on the node axis, targets on the lookup axis;
+    ``byzantine`` and the ``strikes`` counters are replicated like
+    ``alive`` (each round's two [N] strike psums keep every shard's
+    copy identical — see ``_chaos_sharded_body``).  Collective-
+    synchronised while-loop formulation only: chaos scenarios run at
+    sizes whose per-shard table fits twice in HBM (the 10M-node burst
+    dispatcher is a throughput tool, not a fault harness).  Returns
+    ``(LookupResult, strikes [N])``.
+    """
+    n_shards = mesh.shape[AXIS]
+    byz = (swarm.byzantine if swarm.byzantine is not None
+           else jnp.zeros((cfg.n_nodes,), bool))
+    fn = shard_map(
+        partial(_chaos_sharded_body, cfg, n_shards, capacity_factor,
+                faults),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS, None), P(), P(), P(AXIS, None), P()),
+        out_specs=(P(AXIS, None), P(AXIS), P(AXIS), P()),
+        check_vma=False,
+    )
+    found, hops, done, strikes = fn(swarm.ids, swarm.tables,
+                                    swarm.alive, byz, targets, key)
+    return LookupResult(found=found, hops=hops, done=done), strikes
 
 
 # ---------------------------------------------------------------------------

@@ -144,6 +144,84 @@ def test_host_device_conformance(host_cluster):
 
 
 # ---------------------------------------------------------------------------
+# lookup-survival leg: one fault schedule, host loss/partition knobs vs
+# device masks, one band (the chaos twin of test_maintenance_conformance)
+# ---------------------------------------------------------------------------
+
+SURV_KILL_FRAC = 0.10
+SURV_LOSS = 0.15
+N_SURV_LOOKUPS = 96
+
+
+def host_lookup_survival():
+    """Host cluster under the fault schedule's HOST knobs: partition
+    10 % of nodes away (harness kill), let routing maintenance expire
+    the corpses (the virtual-time twin of the device leg's
+    heal_swarm), then resolve random-key gets over a 15 %-loss
+    transport (the netem knob, harness/network.py VirtualNetwork).
+    Requests ride the reference's 3×1 s retransmit, so loss costs
+    retries, not correctness.  Returns mean recall of the answered
+    sets vs the true 8 closest ALIVE nodes."""
+    c = SimCluster(256, seed=17)
+    c.interconnect()
+    c.run(30.0)
+    rng = np.random.default_rng(23)
+    victims = [d for d in c.nodes if rng.random() < SURV_KILL_FRAC]
+    for v in victims:
+        c.kill(v)
+    c.run(45.0)          # maintenance windows expire the corpses
+    c.net.loss = SURV_LOSS
+    alive = [d for d in c.nodes if d not in victims]
+    alive_ids = [d.myid for d in alive]
+    recalls = []
+    for _ in range(N_SURV_LOOKUPS):
+        target = InfoHash(rng.bytes(20))
+        src = alive[int(rng.integers(len(alive)))]
+        done = []
+        src.get(target, lambda vs: True,
+                lambda ok, nodes: done.append([n.id for n in nodes]))
+        c.run_until(lambda: done, timeout=120.0)
+        assert done, "host lookup did not complete under loss"
+        recalls.append(recall_of(done[0], alive_ids, bytes(target)))
+    return float(np.mean(recalls))
+
+
+def device_lookup_survival():
+    """Device engine under the SAME schedule's DEVICE masks: churn
+    10 % + heal_swarm (bucket maintenance), then the chaos lookup path
+    with drop_frac 15 % reply loss (models/swarm.py LookupFaults —
+    lost replies re-solicit next round, the retransmit twin).  Recall
+    vs the true 8 closest alive nodes."""
+    from opendht_tpu.models.swarm import (
+        LookupFaults, chaos_lookup, churn, heal_swarm, lookup_recall,
+    )
+
+    cfg = SwarmConfig.for_nodes(2048)
+    sw = build_swarm(jax.random.PRNGKey(31), cfg)
+    dead = churn(sw, jax.random.PRNGKey(32), SURV_KILL_FRAC, cfg)
+    dead = heal_swarm(dead, cfg, jax.random.PRNGKey(33))
+    targets = jax.random.bits(jax.random.PRNGKey(34), (256, 5),
+                              jnp.uint32)
+    res, _ = chaos_lookup(dead, cfg, targets, jax.random.PRNGKey(35),
+                          LookupFaults(drop_frac=SURV_LOSS, seed=3))
+    assert bool(jnp.all(res.done))
+    return float(jnp.mean(lookup_recall(dead, cfg, res, targets)))
+
+
+def test_lookup_survival_conformance():
+    """One fault schedule, two engines: 10 % node death + 15 %
+    message loss must leave host and device lookup recall in the same
+    0.10 band, each above its own floor — the device chaos knobs
+    (churn/heal_swarm/LookupFaults.drop_frac) are calibrated against
+    the host harness's partition/loss knobs, not free parameters."""
+    s_host = host_lookup_survival()
+    s_dev = device_lookup_survival()
+    assert s_host > 0.85, s_host
+    assert s_dev > 0.9, s_dev
+    assert abs(s_host - s_dev) < 0.10, (s_host, s_dev)
+
+
+# ---------------------------------------------------------------------------
 # storage-semantics leg: same op sequence, both engines, same outcomes
 # ---------------------------------------------------------------------------
 

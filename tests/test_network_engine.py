@@ -239,6 +239,67 @@ def test_blacklist():
     assert not any(c[0] == "ping" for c in h1.calls)
 
 
+def test_blacklist_readmits_after_expiry():
+    """A blacklisted address serves its 10-minute sentence and is then
+    re-admitted — AND its stale entry is actually removed from the map
+    (ref: the reference re-admits on expiry, :344-356)."""
+    from opendht_tpu.core.constants import BLACKLIST_EXPIRE_TIME
+    from opendht_tpu.net.wire import MessageBuilder, make_tid
+
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    e1.blacklist_node(peer)
+    assert e1.is_node_blacklisted(peer.addr)
+    clk.set(clk.now() + BLACKLIST_EXPIRE_TIME + 1.0)
+    sch.sync_time()
+    assert not e1.is_node_blacklisted(peer.addr)
+    assert peer.addr not in e1.blacklist  # purged, not just ignored
+    mb = MessageBuilder(e2.myid, 0)
+    e1.process_message(mb.ping(make_tid(b"pn", 1)), peer.addr)
+    assert any(c[0] == "ping" for c in h1.calls)  # handled again
+
+
+def test_blacklist_purges_stale_entries_on_insert():
+    """Entries whose sentence expired must not accumulate unboundedly:
+    addresses never heard from again are reaped by the next
+    conviction's hygiene sweep, not kept until queried."""
+    from opendht_tpu.core.constants import BLACKLIST_EXPIRE_TIME
+
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    for i in range(10):
+        n = e1.cache.get_node(InfoHash.get(f"bad{i}"),
+                              SockAddr(f"10.1.0.{i + 1}", 4222))
+        e1.blacklist_node(n)
+    assert len(e1.blacklist) == 10
+    clk.set(clk.now() + BLACKLIST_EXPIRE_TIME + 1.0)
+    sch.sync_time()
+    # One new conviction sweeps all 10 stale entries out.
+    fresh = e1.cache.get_node(InfoHash.get("fresh"),
+                              SockAddr("10.2.0.1", 4222))
+    e1.blacklist_node(fresh)
+    assert set(e1.blacklist) == {fresh.addr}
+
+
+def test_blacklist_size_cap():
+    """The blacklist is a BOUNDED set (SURVEY §4: misbehaving-peer
+    LRU): an attacker cycling source addresses cannot grow it past
+    MAX_BLACKLIST_SIZE; soonest-to-expire entries are evicted first,
+    so the newest conviction always sticks."""
+    from opendht_tpu.core.constants import MAX_BLACKLIST_SIZE
+
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    last = None
+    for i in range(MAX_BLACKLIST_SIZE + 50):
+        clk.set(clk.now() + 0.001)   # distinct expiry times
+        sch.sync_time()
+        last = e1.cache.get_node(
+            InfoHash.get(f"flood{i}"),
+            SockAddr(f"10.{(i >> 8) & 255}.{i & 255}.99", 4222))
+        e1.blacklist_node(last)
+    assert len(e1.blacklist) <= MAX_BLACKLIST_SIZE
+    assert e1.is_node_blacklisted(last.addr)
+
+
 def test_stats_counters():
     clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
     peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))

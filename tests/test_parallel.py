@@ -571,3 +571,93 @@ def test_sharded_payload_roundtrip():
     assert hit.mean() > 0.95
     got, want = np.asarray(res.payload), np.asarray(payloads)
     assert (got[hit] == want[hit]).all(), "sharded payload corrupted"
+
+
+# ---------------------------------------------------------------------------
+# adversarial lookups on the routed multi-chip path
+# ---------------------------------------------------------------------------
+
+def _honest_recall(sw, cfg, res, t):
+    from opendht_tpu.models.swarm import honest_recall
+
+    return float(jnp.mean(honest_recall(sw, cfg, res, t)))
+
+
+def test_chaos_sharded_lookup_defense(swarm, mesh):
+    """Byzantine responders on the ROUTED path: poison is injected
+    after the all_to_all brings windows home, strikes merge mesh-wide
+    via per-round psums, and the defended engine must beat the
+    undefended one by a wide margin — same contract as the local
+    chaos engine."""
+    from opendht_tpu.models.swarm import LookupFaults, corrupt_swarm
+    from opendht_tpu.parallel import chaos_sharded_lookup
+
+    bad = corrupt_swarm(swarm, jax.random.PRNGKey(3), 0.05, CFG)
+    targets = jax.random.bits(jax.random.PRNGKey(1), (64, 5),
+                              jnp.uint32)
+    res_d, strikes = chaos_sharded_lookup(
+        bad, CFG, targets, jax.random.PRNGKey(4), mesh,
+        LookupFaults(drop_frac=0.1, seed=5))
+    res_u, _ = chaos_sharded_lookup(
+        bad, CFG, targets, jax.random.PRNGKey(4), mesh,
+        LookupFaults(drop_frac=0.1, seed=5, defend=False))
+    r_def = _honest_recall(bad, CFG, res_d, targets)
+    r_raw = _honest_recall(bad, CFG, res_u, targets)
+    assert bool(jnp.all(res_d.done))
+    assert r_def > 0.9, r_def
+    assert r_def > r_raw + 0.1, (r_def, r_raw)
+    # Convictions are of actual liars (plus rare drop collateral).
+    conv = np.asarray(strikes) >= 3
+    byz = np.asarray(bad.byzantine)
+    assert conv[~byz].mean() < 0.01, conv[~byz].mean()
+
+
+def test_chaos_sharded_matches_local_contract(swarm, mesh):
+    """Clean swarm, no faults: the routed chaos engine behaves like
+    the plain routed engine (recall class, all done, zero strikes)."""
+    from opendht_tpu.models.swarm import LookupFaults, lookup_recall
+    from opendht_tpu.parallel import chaos_sharded_lookup
+
+    targets = jax.random.bits(jax.random.PRNGKey(11), (64, 5),
+                              jnp.uint32)
+    res, strikes = chaos_sharded_lookup(swarm, CFG, targets,
+                                        jax.random.PRNGKey(12), mesh,
+                                        LookupFaults())
+    assert bool(jnp.all(res.done))
+    assert int(jnp.max(strikes)) == 0
+    recall = np.asarray(lookup_recall(swarm, CFG, res, targets))
+    assert recall.mean() > 0.9, recall.mean()
+
+
+def test_sharded_announce_drop_frac_shape_and_loss(swarm, mesh):
+    """drop_exchanges on the SHARDED storage path: the mask must
+    preserve the [P, quorum] found-shape through the routed insert
+    (no silent reshape), lose roughly drop_frac of replicas, and
+    drop_frac=1.0 must store nothing at all."""
+    from opendht_tpu.models.storage import StoreConfig, drop_exchanges
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce, sharded_empty_store, sharded_get,
+    )
+
+    scfg = StoreConfig(slots=8, listen_slots=2, max_listeners=256)
+    p = 128
+    keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+
+    found = jnp.arange(p * CFG.quorum, dtype=jnp.int32).reshape(
+        p, CFG.quorum) % CFG.n_nodes
+    dropped = drop_exchanges(found, 0.5, jax.random.PRNGKey(2))
+    assert dropped.shape == found.shape and dropped.dtype == found.dtype
+
+    store = sharded_empty_store(CFG.n_nodes, scfg, mesh)
+    store, rep = sharded_announce(swarm, CFG, store, scfg, keys, vals,
+                                  seqs, 0, jax.random.PRNGKey(3), mesh,
+                                  capacity_factor=float("inf"),
+                                  drop_frac=1.0,
+                                  drop_key=jax.random.PRNGKey(4))
+    assert int(jnp.sum(rep.replicas)) == 0
+    res = sharded_get(swarm, CFG, store, scfg, keys,
+                      jax.random.PRNGKey(5), mesh,
+                      capacity_factor=float("inf"))
+    assert float(jnp.mean(res.hit)) == 0.0

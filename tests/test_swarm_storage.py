@@ -435,6 +435,66 @@ class TestChaosSurvival:
         assert hit.mean() > 0.9    # a couple of replicas suffice
         assert (np.asarray(res.val)[hit] == np.asarray(vals)[hit]).all()
 
+    def test_drop_exchanges_deterministic_under_fixed_key(self):
+        """The loss mask is a pure function of (key, shape, frac):
+        a chaos run replays bit-for-bit under a fixed drop_key, and a
+        different key draws a different schedule."""
+        from opendht_tpu.models.storage import drop_exchanges
+
+        found = (jnp.arange(24 * 8, dtype=jnp.int32)
+                 .reshape(24, 8) % 2048)
+        a = drop_exchanges(found, 0.4, jax.random.PRNGKey(9))
+        b = drop_exchanges(found, 0.4, jax.random.PRNGKey(9))
+        assert (np.asarray(a) == np.asarray(b)).all()
+        c = drop_exchanges(found, 0.4, jax.random.PRNGKey(10))
+        assert (np.asarray(a) != np.asarray(c)).any()
+        # shape/dtype preserved; no drop without a key (the no-op path)
+        assert a.shape == found.shape and a.dtype == found.dtype
+        assert drop_exchanges(found, 0.4, None) is found
+        assert drop_exchanges(found, 0.0,
+                              jax.random.PRNGKey(9)) is found
+
+    def test_drop_frac_one_then_clean_sweep_converges(self,
+                                                      small_swarm):
+        """drop_frac=1.0: EVERY exchange of the sweep is lost — zero
+        replicas move, nothing corrupts — and a subsequent clean sweep
+        restores full replication (maintenance heals total outage, it
+        does not compound it)."""
+        swarm, cfg = small_swarm
+        store = empty_store(cfg.n_nodes, SCFG)
+        p = 64
+        keys = _rand_keys(150, p)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((p,), jnp.uint32)
+        # Announce lost entirely: nothing stored anywhere.
+        store, rep = announce(swarm, cfg, store, SCFG, keys, vals,
+                              seqs, 0, jax.random.PRNGKey(151),
+                              drop_frac=1.0,
+                              drop_key=jax.random.PRNGKey(152))
+        assert int(np.asarray(rep.replicas).sum()) == 0
+        res = get_values(swarm, cfg, store, SCFG, keys,
+                         jax.random.PRNGKey(153))
+        assert float(np.asarray(res.hit).mean()) == 0.0
+        # Clean re-announce, then a TOTAL-loss republish sweep: the
+        # sweep is a no-op, not a corruption.
+        store, _ = announce(swarm, cfg, store, SCFG, keys, vals, seqs,
+                            1, jax.random.PRNGKey(154))
+        all_idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+        store, rrep = republish_from(swarm, cfg, store, SCFG, all_idx,
+                                     2, jax.random.PRNGKey(155),
+                                     drop_frac=1.0,
+                                     drop_key=jax.random.PRNGKey(156))
+        assert int(np.asarray(rrep.replicas).sum()) == 0
+        # A subsequent CLEAN sweep converges to full recall.
+        store, rrep2 = republish_from(swarm, cfg, store, SCFG, all_idx,
+                                      3, jax.random.PRNGKey(157))
+        assert int(np.asarray(rrep2.replicas).sum()) > 0
+        res = get_values(swarm, cfg, store, SCFG, keys,
+                         jax.random.PRNGKey(158))
+        hit = np.asarray(res.hit)
+        assert hit.mean() > 0.95, hit.mean()
+        assert (np.asarray(res.val)[hit] == np.asarray(vals)[hit]).all()
+
     def test_survival_bound_after_mass_kill_one_sweep(self, small_swarm):
         """The satellite chaos test: kill kill_frac of the storing
         nodes, run ONE maintenance sweep (under exchange loss), and
