@@ -27,9 +27,14 @@ bench:
 # storage (mid-republish mass death + exchange loss + the listener
 # lifecycle) and lookup (Byzantine responders + reply loss + the
 # strike/blacklist defense, defended vs undefended).
+# The 100k leg runs with the flight recorder ON (--trace-out) and the
+# artifact is then validated: parses, round counters monotone, and
+# consistent with the reported done_frac/recall — a bench whose trace
+# cannot explain its own numbers must not gate green.
 gate: test
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
-	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256
+	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256 --trace-out /tmp/trace.json
+	python -m opendht_tpu.tools.check_trace /tmp/trace.json
 	python bench.py --mode chaos --nodes 16384 --puts 2048
 	python bench.py --mode chaos-lookup --nodes 16384 --lookups 4096 --recall-sample 256
 

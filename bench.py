@@ -131,6 +131,12 @@ def main():
                          "(the mult_time persistence scenario)")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture an XLA profiler trace of one timed run")
+    ap.add_argument("--trace-out", metavar="FILE", default=None,
+                    help="flight recorder: dump the per-round device "
+                         "trace (requests/replies/drops/churn/done per "
+                         "round + hop-count histogram) of the last "
+                         "timed run as JSON alongside the BENCH row "
+                         "(lookups and chaos-lookup modes)")
     ap.add_argument("--decompose", action="store_true",
                     help="sharded mode: measure the overhead ladder "
                          "(local bursts → shard_map/while_loop "
@@ -176,7 +182,8 @@ def main():
         return chaos_main(args)
 
     from opendht_tpu.models.swarm import (
-        SwarmConfig, build_swarm, lookup, true_closest,
+        SwarmConfig, build_swarm, lookup, merge_traces, traced_lookup,
+        true_closest,
     )
 
     kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
@@ -204,9 +211,23 @@ def main():
         # multi-MB array transfer inside the timed region.
         return int(np.asarray(jnp.sum(res.found[:, 0])))
 
+    # Flight recorder: the traced engine is seed-identical to the plain
+    # one (the trace is a pure observer), so with --trace-out the TIMED
+    # runs themselves run traced — the reported rate includes capture
+    # cost, keeping the <=5% overhead budget honest.
+    use_trace = bool(args.trace_out)
+    traces = []
+
     def run_all(seed):
-        rs = [lookup(swarm, cfg, c, jax.random.PRNGKey(seed + i))
-              for i, c in enumerate(chunks)]
+        if use_trace:
+            pairs = [traced_lookup(swarm, cfg, c,
+                                   jax.random.PRNGKey(seed + i))
+                     for i, c in enumerate(chunks)]
+            rs = [p[0] for p in pairs]
+            traces[:] = [p[1] for p in pairs]
+        else:
+            rs = [lookup(swarm, cfg, c, jax.random.PRNGKey(seed + i))
+                  for i, c in enumerate(chunks)]
         for r in rs:
             sync(r)
         return rs
@@ -264,7 +285,28 @@ def main():
     }
     if recall_error is not None:
         out["recall_error"] = recall_error
+    if use_trace:
+        dump_trace(args.trace_out, out, merge_traces(traces),
+                   args.lookups, res.hops, cfg.max_steps)
     print(json.dumps(out))
+
+
+def dump_trace(path, bench_row, trace, n_lookups, hops, max_steps):
+    """Write the flight-recorder artifact: the BENCH row, the merged
+    per-round trace, and the hop-count histogram — one JSON object,
+    parseable by ``opendht_tpu.tools.check_trace`` (the gate leg)."""
+    from opendht_tpu.models.swarm import hop_histogram, trace_to_dict
+
+    hist = [int(v) for v in np.asarray(hop_histogram(hops, max_steps))]
+    obj = {
+        "kind": "swarm_lookup_trace",
+        "bench": bench_row,
+        "trace": trace_to_dict(trace, n_lookups),
+        "hop_histogram": hist,
+    }
+    with open(path, "w") as f:
+        json.dump(obj, f)
+        f.write("\n")
 
 
 def auto_slots(args, cfg):
@@ -1135,15 +1177,23 @@ def chaos_lookup_main(args):
                        jax.random.PRNGKey(2), kf, cfg)
         healed = heal_swarm(healed, cfg, jax.random.PRNGKey(3))
 
-    def leg(kill, byz, drop, defend=True):
+    captured = {}
+
+    def leg(kill, byz, drop, defend=True, collect=False):
         sw = healed if kill else swarm
         if byz:
             sw = corrupt_swarm(sw, jax.random.PRNGKey(4), byz, cfg)
         faults = LookupFaults(drop_frac=drop, eclipse=eclipse, seed=11,
                               defend=defend)
         t0 = time.perf_counter()
-        res, strikes = chaos_lookup(sw, cfg, targets,
-                                    jax.random.PRNGKey(5), faults)
+        if collect:
+            res, strikes, trace = chaos_lookup(
+                sw, cfg, targets, jax.random.PRNGKey(5), faults,
+                collect_trace=True)
+            captured["trace"], captured["hops"] = trace, res.hops
+        else:
+            res, strikes = chaos_lookup(sw, cfg, targets,
+                                        jax.random.PRNGKey(5), faults)
         _ = int(np.asarray(jnp.sum(res.found[:, 0])))   # completion
         dt = time.perf_counter() - t0
         # Recall vs the true 8 closest honest alive nodes, sampled.
@@ -1177,7 +1227,10 @@ def chaos_lookup_main(args):
              leg(kf, 0.0, 0.0),
              leg(0.0, bf, 0.0),
              leg(0.0, 0.0, df)]
-    headline = leg(kf, bf, df)
+    # The headline (full-fault) leg carries the flight recorder when
+    # --trace-out is set: its per-round poison/strike/conviction rows
+    # are what EXPLAIN the degradation numbers below.
+    headline = leg(kf, bf, df, collect=bool(args.trace_out))
     undefended = leg(kf, bf, df, defend=False)
     clean = curve[0]
 
@@ -1206,6 +1259,9 @@ def chaos_lookup_main(args):
                         - undefended["recall_at_8"], 4)},
         "platform": jax.devices()[0].platform,
     }
+    if args.trace_out:
+        dump_trace(args.trace_out, out, captured["trace"],
+                   args.lookups, captured["hops"], cfg.max_steps)
     print(json.dumps(out))
 
 

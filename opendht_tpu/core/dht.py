@@ -38,6 +38,7 @@ from ..net.wire import WANT4, WANT6
 from ..utils.clock import TIME_INVALID, TIME_MAX
 from ..utils.infohash import HASH_LEN, InfoHash
 from ..utils.logger import NONE, Logger
+from ..utils.metrics import MetricsRegistry
 from ..utils.sockaddr import AF_INET, AF_INET6, SockAddr
 from .constants import (LISTEN_EXPIRE_TIME, MAX_HASHES, MAX_SEARCHES,
                         MAX_STORAGE_MAINTENANCE_EXPIRE_TIME, MAX_STORAGE_SIZE,
@@ -88,6 +89,48 @@ class NodeStatus:
     Disconnected = "disconnected"
     Connecting = "connecting"
     Connected = "connected"
+
+
+class NodeStats:
+    """Snapshot of one address family's node health + this node's
+    search/storage load — the reference's ``NodeStats`` struct
+    (returned by ``getNodesStats``, ref src/dht.cpp:2469-2495) grown
+    with the search and storage counters the reference reports through
+    separate log dumps.
+    """
+
+    __slots__ = ("good_nodes", "dubious_nodes", "cached_nodes",
+                 "incoming_nodes", "searches", "storage_keys",
+                 "storage_values", "storage_bytes")
+
+    def __init__(self, good_nodes: int = 0, dubious_nodes: int = 0,
+                 cached_nodes: int = 0, incoming_nodes: int = 0,
+                 searches: int = 0, storage_keys: int = 0,
+                 storage_values: int = 0, storage_bytes: int = 0):
+        self.good_nodes = good_nodes
+        self.dubious_nodes = dubious_nodes
+        self.cached_nodes = cached_nodes
+        self.incoming_nodes = incoming_nodes
+        self.searches = searches
+        self.storage_keys = storage_keys
+        self.storage_values = storage_values
+        self.storage_bytes = storage_bytes
+
+    @property
+    def total_nodes(self) -> int:
+        return self.good_nodes + self.dubious_nodes
+
+    def to_dict(self) -> Dict[str, int]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self) -> str:
+        return (f"NodeStats(good={self.good_nodes}, "
+                f"dubious={self.dubious_nodes}, "
+                f"cached={self.cached_nodes}, "
+                f"incoming={self.incoming_nodes}, "
+                f"searches={self.searches}, "
+                f"storage={self.storage_values} values/"
+                f"{self.storage_bytes} B in {self.storage_keys} keys)")
 
 
 class Get:
@@ -571,9 +614,13 @@ class Dht:
         self.scheduler = scheduler or Scheduler()
 
         self.cache = NodeCache()
+        # One registry shared with the engine: wire counters and core
+        # gauges expose through a single /metrics surface.
+        self.metrics = MetricsRegistry()
         self.engine = NetworkEngine(self.myid, config.network, transport4,
                                     transport6, self.scheduler, self,
-                                    self.cache, logger, self.rng)
+                                    self.cache, logger, self.rng,
+                                    metrics=self.metrics)
         self.running4 = transport4 is not None
         self.running6 = transport6 is not None
 
@@ -661,6 +708,59 @@ class Dht:
             if b.cached is not None:
                 cached += 1
         return good, dubious, cached, incoming
+
+    def node_stats(self, af: int = AF_INET) -> NodeStats:
+        """Full :class:`NodeStats` snapshot for one address family —
+        the reference ``getNodesStats`` struct plus this node's search
+        and storage load (storage totals are node-global: the store is
+        not per-af)."""
+        good, dubious, cached, incoming = self.get_nodes_stats(af)
+        return NodeStats(
+            good_nodes=good, dubious_nodes=dubious, cached_nodes=cached,
+            incoming_nodes=incoming, searches=len(self.searches(af)),
+            storage_keys=len(self.store),
+            storage_values=self.total_values,
+            storage_bytes=self.total_store_size)
+
+    def update_metrics(self) -> None:
+        """Refresh the registry's gauges from live core state.  Called
+        by periodic maintenance (:meth:`_confirm_nodes`/:meth:`_expire`)
+        and by exposition surfaces at scrape time — the gauges are
+        derived state, so recomputing is always safe.
+
+        Scrape-time calls arrive from gateway HTTP threads while the
+        DHT loop thread mutates core state (same diagnostics-read
+        contract as ``DhtRunner.get_nodes_stats``): the dict iterations
+        below work on ``list()`` snapshots, and the gateway converts
+        the residual snapshot race (a dict resized mid-copy raises
+        RuntimeError) into a 503 — gauges then refresh on the next
+        scrape or maintenance tick instead of crashing the handler."""
+        nodes_g = self.metrics.gauge(
+            "dht_nodes", "Routing-table nodes by state", ("af", "state"))
+        searches_g = self.metrics.gauge(
+            "dht_searches", "Live searches", ("af",))
+        for af, name in ((AF_INET, "ipv4"), (AF_INET6, "ipv6")):
+            good, dubious, cached, incoming = self.get_nodes_stats(af)
+            nodes_g.set(good, af=name, state="good")
+            nodes_g.set(dubious, af=name, state="dubious")
+            nodes_g.set(cached, af=name, state="cached")
+            nodes_g.set(incoming, af=name, state="incoming")
+            searches_g.set(len(self.searches(af)), af=name)
+        self.metrics.gauge(
+            "dht_storage_keys", "Distinct stored info-hashes"
+        ).set(len(self.store))
+        self.metrics.gauge(
+            "dht_storage_values", "Stored values"
+        ).set(self.total_values)
+        self.metrics.gauge(
+            "dht_storage_bytes", "Stored value bytes"
+        ).set(self.total_store_size)
+        listeners = sum(
+            sum(len(socks) for socks in list(st.listeners.values()))
+            + len(st.local_listeners) for st in list(self.store.values()))
+        self.metrics.gauge(
+            "dht_storage_listeners", "Registered storage listeners"
+        ).set(listeners)
 
     # ------------------------------------------------------------------ #
     # tokens (ref: src/dht.cpp:2404-2467)                                #
@@ -1765,6 +1865,10 @@ class Dht:
         delay = self.rng.uniform(5, 25) if soon else self.rng.uniform(60, 180)
         self._confirm_job = self.scheduler.add(now + delay,
                                                self._confirm_nodes)
+        self.metrics.counter(
+            "dht_maintenance_total", "Periodic maintenance runs",
+            ("op",)).inc(op="confirm_nodes")
+        self.update_metrics()
         self._check_status_change()
 
     def _check_status_change(self) -> None:
@@ -1827,6 +1931,10 @@ class Dht:
         self._expire_storage()
         self._expire_searches()
         self.scheduler.add(now + self.rng.uniform(120, 360), self._expire)
+        self.metrics.counter(
+            "dht_maintenance_total", "Periodic maintenance runs",
+            ("op",)).inc(op="expire")
+        self.update_metrics()
         self._check_status_change()
 
     def _expire_storage(self) -> None:

@@ -94,7 +94,7 @@ def announce_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     # rejects need_words > parts·w), silently wasting replica budget.
     lengths = jnp.minimum(lengths, jnp.uint32(parts * w * 4))
     words = -(-lengths.astype(jnp.int32) // 4)               # [P]
-    rep0 = None
+    rep0, trace = None, None
     for j in range(parts):
         # Part 0 is active unconditionally (it carries the value's
         # existence and true length — including length 0).
@@ -102,13 +102,14 @@ def announce_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
         found_j = jnp.where(active[:, None], res.found, -1)
         sizes_j = (lengths.astype(jnp.uint32) if j == 0
                    else jnp.ones_like(lengths, jnp.uint32))
-        store, rep = _announce_insert(
+        store, rep, tr = _announce_insert(
             swarm.alive, cfg, store, scfg, found_j, part_key(keys, j),
             vals, seqs, jnp.uint32(now), sizes_j, None, payloads[:, j])
+        trace = tr if trace is None else trace + tr
         if j == 0:
             rep0 = rep
     return store, AnnounceReport(replicas=rep0, hops=res.hops,
-                                 done=res.done)
+                                 done=res.done, trace=trace)
 
 
 def get_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,

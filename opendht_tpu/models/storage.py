@@ -242,10 +242,79 @@ class SwarmStore(NamedTuple):
     npayload: jax.Array  # [max_listeners,W] uint32 — delivered bytes
 
 
+class StoreTrace(NamedTuple):
+    """Flight-recorder counters for ONE storage sweep (scalar int32
+    leaves, accumulated on-device inside the insert program — read
+    them with one ``device_get``, never per-field fetches).
+
+    The storage twin of :class:`~opendht_tpu.models.swarm.LookupTrace`:
+    where the reference's ``storageStore`` returns a per-call bool and
+    logs, the batched engine folds the whole sweep's outcome taxonomy
+    into five reductions.  Under the sharded engine the leaves are
+    psum-reduced before leaving the shard_map body, so the host always
+    sees mesh-global numbers.
+
+    * ``requests``       — storage RPCs that reached a live store;
+    * ``accepts_update`` — edit-policy overwrites/refreshes accepted;
+    * ``accepts_new``    — new-key ring inserts accepted;
+    * ``rejects``        — surviving requests refused (stale seq,
+      equal-seq conflict, byte budget, ring overflow/conflict);
+    * ``notified``       — listener delivery matches fired
+      (``storageChanged`` → ``tellListener`` pushes).
+    """
+    requests: jax.Array
+    accepts_update: jax.Array
+    accepts_new: jax.Array
+    rejects: jax.Array
+    notified: jax.Array
+
+    @staticmethod
+    def zeros() -> "StoreTrace":
+        z = jnp.int32(0)
+        return StoreTrace(z, z, z, z, z)
+
+    def __add__(self, other: "StoreTrace") -> "StoreTrace":
+        return StoreTrace(*[a + b for a, b in zip(self, other)])
+
+    def to_dict(self) -> dict:
+        host = jax.device_get(self)
+        return {k: int(v) for k, v in zip(self._fields, host)}
+
+
+class StoreStats(NamedTuple):
+    """Point-in-time device-side storage gauges (one reduction pass —
+    the device analogue of the host ``get_storage_log`` summary line /
+    ``total_store_size``/``total_values`` counters)."""
+    values: jax.Array          # live stored values
+    stored_bytes: jax.Array    # sum of live value sizes (abstract units)
+    listeners: jax.Array       # live listener-table registrations
+    pending_notifies: jax.Array  # delivery slots awaiting an ack
+
+    def to_dict(self) -> dict:
+        host = jax.device_get(self)
+        return {k: int(v) for k, v in zip(self._fields, host)}
+
+
+@jax.jit
+def store_stats(store: SwarmStore) -> StoreStats:
+    """Compute :class:`StoreStats` gauges for a (local or sharded)
+    store.  Elementwise reductions — under a ``NamedSharding`` XLA
+    reduces shard-local and combines, so the single-chip op IS the
+    sharded one."""
+    return StoreStats(
+        values=jnp.sum(store.used.astype(jnp.int32)),
+        stored_bytes=jnp.sum(
+            jnp.where(store.used, store.sizes, 0), dtype=jnp.uint32),
+        listeners=jnp.sum((store.lids >= 0).astype(jnp.int32)),
+        pending_notifies=jnp.sum(store.notified.astype(jnp.int32)))
+
+
 class AnnounceReport(NamedTuple):
     replicas: jax.Array  # [P] int32 — copies stored per put
     hops: jax.Array      # [P] — lookup rounds
     done: jax.Array      # [P] bool — lookup converged
+    # Sweep telemetry (None on paths that don't collect it).
+    trace: "StoreTrace | None" = None
 
 
 class GetResult(NamedTuple):
@@ -357,7 +426,7 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
                   req_size: jax.Array | None = None,
                   req_ttl: jax.Array | None = None,
                   put_payloads: jax.Array | None = None
-                  ) -> Tuple[SwarmStore, jax.Array]:
+                  ) -> Tuple[SwarmStore, jax.Array, StoreTrace]:
     """Insert a flat batch of (node, key, val, seq) storage requests.
 
     ``req_node [M]`` (-1 = skip), ``req_key [M,5]``, ``req_val [M]``,
@@ -365,9 +434,10 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     ``req_size``/``req_ttl`` optional ``[M]`` (default 1 / cfg
     default).  ``put_payloads [Pmax, W]``: optional real value bytes,
     indexed by ``req_put`` (per-PUT, not per-request, so the request
-    sort never carries W-wide columns).  Returns the new store and
+    sort never carries W-wide columns).  Returns the new store,
     accepted-replica counts scattered by ``req_put`` into a length-M
-    vector (callers slice the first P rows).
+    vector (callers slice the first P rows), and the sweep's
+    :class:`StoreTrace` counters.
 
     Semantics per request, mirroring ``Dht::storageStore`` +
     ``secureType`` edit policy
@@ -587,7 +657,18 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     put_safe = jnp.clip(s_put, 0, None)
     replicas = jnp.zeros((m,), jnp.int32).at[put_safe].add(
         accepted.astype(jnp.int32))
-    return new_store, replicas
+    i32 = jnp.int32
+    trace = StoreTrace(
+        requests=jnp.sum(valid.astype(i32)),
+        accepts_update=jnp.sum(upd.astype(i32)),
+        accepts_new=jnp.sum(accept_new.astype(i32)),
+        # Surviving (post-dedup) requests refused by the edit policy,
+        # byte budget, or ring allocation — what the reference's
+        # storageStore-returns-false / "seq must be increasing" paths
+        # count one call at a time.
+        rejects=jnp.sum((live & ~upd & ~accept_new).astype(i32)),
+        notified=jnp.sum(lmatch.astype(i32)))
+    return new_store, replicas, trace
 
 
 # ---------------------------------------------------------------------------
@@ -610,7 +691,7 @@ def _announce_insert(alive: jax.Array, cfg: SwarmConfig,
                      now: jax.Array, sizes: jax.Array | None = None,
                      ttls: jax.Array | None = None,
                      payloads: jax.Array | None = None
-                     ) -> Tuple[SwarmStore, jax.Array]:
+                     ) -> Tuple[SwarmStore, jax.Array, StoreTrace]:
     # Takes the bare ``alive`` mask, NOT the whole swarm: the runtime
     # keeps every jit input resident (no unused-arg pruning through the
     # AOT tunnel), and a rides-along 10 GB routing table was the
@@ -624,10 +705,10 @@ def _announce_insert(alive: jax.Array, cfg: SwarmConfig,
     req_put = jnp.repeat(jnp.arange(p, dtype=jnp.int32), q, axis=0)
     req_size = None if sizes is None else jnp.repeat(sizes, q, axis=0)
     req_ttl = None if ttls is None else jnp.repeat(ttls, q, axis=0)
-    store, rep_m = _store_insert(store, scfg, req_node, req_key, req_val,
-                                 req_seq, req_put, now, req_size,
-                                 req_ttl, payloads)
-    return store, rep_m[:p]
+    store, rep_m, trace = _store_insert(store, scfg, req_node, req_key,
+                                        req_val, req_seq, req_put, now,
+                                        req_size, req_ttl, payloads)
+    return store, rep_m[:p], trace
 
 
 def drop_exchanges(found: jax.Array, drop_frac: float,
@@ -662,11 +743,11 @@ def announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     storage-RPC loss (see :func:`drop_exchanges`)."""
     res = _announce_targets(swarm, cfg, keys, rng)
     found = drop_exchanges(res.found, drop_frac, drop_key)
-    store, replicas = _announce_insert(
+    store, replicas, trace = _announce_insert(
         swarm.alive, cfg, store, scfg, found, keys, vals, seqs,
         jnp.uint32(now), sizes, ttls, payloads)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
-                                 done=res.done)
+                                 done=res.done, trace=trace)
 
 
 @partial(jax.jit, static_argnames=("cfg", "scfg"))
@@ -929,9 +1010,9 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     res = lookup(swarm, cfg, keys, rng)
     found = jnp.where(okf[:, None], res.found, -1)
     found = drop_exchanges(found, drop_frac, drop_key)
-    store, replicas = _announce_insert(swarm.alive, cfg, store, scfg,
-                                       found, keys, vals, seqs,
-                                       jnp.uint32(now), sizes, ttls,
-                                       payloads)
+    store, replicas, trace = _announce_insert(swarm.alive, cfg, store,
+                                              scfg, found, keys, vals,
+                                              seqs, jnp.uint32(now),
+                                              sizes, ttls, payloads)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
-                                 done=res.done)
+                                 done=res.done, trace=trace)

@@ -262,6 +262,107 @@ class LookupResult(NamedTuple):
     done: jax.Array   # [L]
 
 
+class LookupTrace(NamedTuple):
+    """Flight recorder: per-round device-side lookup telemetry.
+
+    Every counter is a ``[max_steps] int32`` row indexed by
+    solicitation round, accumulated INSIDE the jitted round loop with
+    ``at[rnd]`` scatters — no host syncs ride the burst loop; the whole
+    pytree is materialized once when the caller reads it
+    (:func:`trace_to_dict`).  This is the device twin of the host
+    engine's per-message-type counters (net/network_engine.py
+    metrics), capturing what the papers say is the diagnostic signal
+    for lookup health: per-round convergence and churn distributions
+    (arXiv 1307.7000 §IV, 1408.3079 §3).
+
+    Fields that are per-shard partial sums under the table-sharded
+    engine reduce with ``psum``; fields computed from already-replicated
+    state (``strikes``/``convictions`` after the chaos strike psums,
+    ``rounds``) reduce with ``pmax`` — see
+    :func:`opendht_tpu.parallel.sharded._trace_allreduce`.
+
+    * ``requests``  — solicitations issued (α-slots holding a node);
+    * ``replies``   — candidate entries that reached the merge (post
+      drop/poison filtering);
+    * ``drops``     — solicitations that returned nothing: dead
+      targets, capacity-shed sends, in-transit losses;
+    * ``poison``    — contradicted distance claims detected (chaos
+      defend path; 0 elsewhere);
+    * ``strikes``   — strike-counter increments (chaos defend path);
+    * ``convictions`` — blacklisted nodes at round end (gauge);
+    * ``churn``     — shortlist slots whose occupant changed;
+    * ``done``      — lookups done at round end (gauge, monotone);
+    * ``rounds``    — scalar: rounds actually executed.
+    """
+    requests: jax.Array     # [R] int32
+    replies: jax.Array      # [R] int32
+    drops: jax.Array        # [R] int32
+    poison: jax.Array       # [R] int32
+    strikes: jax.Array      # [R] int32
+    convictions: jax.Array  # [R] int32 (gauge)
+    churn: jax.Array        # [R] int32
+    done: jax.Array         # [R] int32 (gauge)
+    rounds: jax.Array       # []  int32
+
+
+def empty_lookup_trace(cfg: SwarmConfig) -> LookupTrace:
+    z = jnp.zeros((cfg.max_steps,), jnp.int32)
+    return LookupTrace(requests=z, replies=z, drops=z, poison=z,
+                       strikes=z, convictions=z, churn=z, done=z,
+                       rounds=jnp.int32(0))
+
+
+def merge_traces(traces) -> LookupTrace:
+    """Combine traces of DISJOINT lookup batches (bench chunks).
+
+    Counters sum element-wise (each chunk's lookups — and, for chaos
+    runs, its per-batch strike state — are independent) and ``rounds``
+    takes the max.  The GAUGES (``done``, ``convictions``) are
+    forward-filled past each chunk's own exit round first: a chunk
+    that converged in 7 rounds still holds all its lookups done while
+    a 9-round sibling finishes, so without the fill the merged done
+    gauge would DIP at round 7 and undercount the final row —
+    summing raw gauge rows across different round counts is the bug,
+    not the contract.
+    """
+    def fill_forward(t: LookupTrace) -> LookupTrace:
+        r = jnp.maximum(t.rounds, 1)
+        idx = jnp.arange(t.done.shape[0])
+        ff = lambda row: jnp.where(idx < r, row, row[r - 1])
+        return t._replace(done=ff(t.done),
+                          convictions=ff(t.convictions))
+
+    out = fill_forward(traces[0])
+    for t in traces[1:]:
+        t = fill_forward(t)
+        out = LookupTrace(
+            *[jnp.maximum(a, b) if name == "rounds" else a + b
+              for name, a, b in zip(LookupTrace._fields, out, t)])
+    return out
+
+
+def trace_to_dict(trace: LookupTrace,
+                  n_lookups: int | None = None) -> dict:
+    """One host materialization of the whole trace (a single
+    ``device_get``, never per-element fetches) → a JSON-able dict with
+    counters truncated to the executed rounds."""
+    host = jax.device_get(trace)
+    r = max(1, int(host.rounds))
+    out = {
+        "rounds": int(host.rounds),
+        "max_steps": int(host.requests.shape[0]),
+        "counters": {
+            name: [int(v) for v in getattr(host, name)[:r]]
+            for name in LookupTrace._fields if name != "rounds"
+        },
+    }
+    if n_lookups:
+        out["n_lookups"] = int(n_lookups)
+        out["done_frac"] = [round(int(d) / n_lookups, 6)
+                            for d in host.done[:r]]
+    return out
+
+
 # ---------------------------------------------------------------------------
 # bit helpers on packed ids (work with traced bit positions)
 # ---------------------------------------------------------------------------
@@ -741,10 +842,17 @@ def init_impl(ids: jax.Array, respond, cfg: SwarmConfig,
 
 
 def step_impl(ids: jax.Array, alive: jax.Array, respond,
-              cfg: SwarmConfig, st: LookupState) -> LookupState:
+              cfg: SwarmConfig, st: LookupState,
+              trace: LookupTrace | None = None,
+              rnd: jax.Array | None = None):
     """Shared lock-step solicitation round (vectorized ``searchStep``,
     src/dht.cpp:1343-1464): select α unqueried, solicit via
-    ``respond``, merge responses, re-sort, check sync quorum."""
+    ``respond``, merge responses, re-sort, check sync quorum.
+
+    With a ``trace`` (and its round index ``rnd``), returns
+    ``(state, trace)`` with the round's counters folded in — the
+    flight-recorder path; ``trace=None`` (default) keeps the bare
+    hot-path signature."""
     # Finished lookups stop soliciting: besides wasting gathers, their
     # traffic would consume bounded all_to_all capacity and could
     # starve still-active queries on a hot shard.
@@ -753,12 +861,14 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     sel_alive = (sel >= 0) & alive[jnp.clip(sel, 0, cfg.n_nodes - 1)]
     resp, resp_d0, answered = respond(st.targets, sel, sel_d0)  # [L,A*2K]
     return _merge_round(st, cfg, sel, sel_alive, answered, resp,
-                        resp_d0)
+                        resp_d0, trace=trace, rnd=rnd)
 
 
 def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
                  sel_alive: jax.Array, answered: jax.Array,
-                 resp: jax.Array, resp_d0: jax.Array) -> LookupState:
+                 resp: jax.Array, resp_d0: jax.Array,
+                 trace: LookupTrace | None = None,
+                 rnd: jax.Array | None = None):
     """Round tail shared by the plain and chaos engines: fold the α
     solicitations' outcomes into the shortlist, merge, re-sort, check
     the sync quorum.  ONE copy of the merge/eviction/done semantics,
@@ -797,9 +907,26 @@ def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
     # state (equal-d0 ties order by node index from pass 1, independent
     # of input order) — f_* already equal st.* bit-for-bit for done
     # rows.  The wheres cost three [L,S] copies per round.
-    return LookupState(
+    new_st = LookupState(
         targets=st.targets, idx=f_idx, dist=f_dist, queried=f_q,
         done=done, hops=st.hops + active.astype(jnp.int32))
+    if trace is None:
+        return new_st
+    i32 = jnp.int32
+    trace = trace._replace(
+        requests=trace.requests.at[rnd].add(
+            jnp.sum((sel >= 0).astype(i32)), mode="drop"),
+        replies=trace.replies.at[rnd].add(
+            jnp.sum((resp >= 0).astype(i32)), mode="drop"),
+        drops=trace.drops.at[rnd].add(
+            jnp.sum(((sel >= 0) & (~sel_alive | ~answered)).astype(i32)),
+            mode="drop"),
+        churn=trace.churn.at[rnd].add(
+            jnp.sum((f_idx != st.idx).astype(i32)), mode="drop"),
+        done=trace.done.at[rnd].set(jnp.sum(done.astype(i32)),
+                                    mode="drop"),
+        rounds=jnp.maximum(trace.rounds, i32(rnd) + 1))
+    return new_st, trace
 
 
 def _resp_dist(ids: jax.Array, cfg: SwarmConfig, targets: jax.Array,
@@ -939,6 +1066,36 @@ def run_burst_loop(step_fn, state, cfg: SwarmConfig,
     return state
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def traced_lookup_step(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+                       trace: LookupTrace, rnd: jax.Array):
+    return step_impl(swarm.ids, swarm.alive, _local_respond(swarm, cfg),
+                     cfg, st, trace=trace, rnd=rnd)
+
+
+def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
+                  key: jax.Array) -> tuple[LookupResult, LookupTrace]:
+    """:func:`lookup` with the flight recorder on: identical semantics
+    and seeds (same origins, same solicitation schedule — the trace
+    scatters are pure observers), returning ``(result, LookupTrace)``.
+
+    The trace rides the burst-loop carry, so capture adds ZERO extra
+    host syncs — the only readbacks are the burst loop's existing
+    done-checks; the trace itself stays on device until the caller
+    materializes it (:func:`trace_to_dict`, one ``device_get``).
+    """
+    l = targets.shape[0]
+    origins = _sample_origins(key, swarm.alive, l)
+    st = lookup_init(swarm, cfg, targets, origins)
+    trace = empty_lookup_trace(cfg)
+    st, trace = run_burst_loop(
+        lambda c, r: traced_lookup_step(swarm, cfg, c[0], c[1],
+                                        jnp.int32(r)),
+        (st, trace), cfg, done_of=lambda c: c[0].done)
+    return (LookupResult(found=_finalize(swarm.ids, st, cfg),
+                         hops=st.hops, done=st.done), trace)
+
+
 @partial(jax.jit, static_argnames=("cfg", "n_steps"))
 def lookup_steps(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
                  n_steps: int) -> LookupState:
@@ -1002,6 +1159,18 @@ def lookup_recall(swarm: Swarm, cfg: SwarmConfig, result: LookupResult,
     match = (truth[:, :, None] == found[:, None, :]) & (
         truth[:, :, None] >= 0)
     return jnp.any(match, axis=2).mean(axis=1)
+
+
+@partial(jax.jit, static_argnames=("max_steps",))
+def hop_histogram(hops: jax.Array, max_steps: int) -> jax.Array:
+    """``[max_steps + 1] int32`` histogram of per-lookup solicitation
+    rounds: bin ``r`` counts lookups that converged in exactly ``r``
+    rounds, the last bin absorbing ``>= max_steps`` (non-converged).
+    One scatter-add — the device-side form of the hop-count
+    distributions that arXiv 1307.7000/1408.3079 use as the lookup-
+    health diagnostic; sums to the lookup count by construction."""
+    h = jnp.clip(hops, 0, max_steps).astype(jnp.int32)
+    return jnp.zeros((max_steps + 1,), jnp.int32).at[h].add(1)
 
 
 def honest_recall(swarm: Swarm, cfg: SwarmConfig, result: LookupResult,
@@ -1090,7 +1259,8 @@ def chaos_step_impl(ids: jax.Array, alive: jax.Array,
                     byzantine: jax.Array | None, respond,
                     cfg: SwarmConfig, faults: LookupFaults,
                     st: LookupState, strikes: jax.Array,
-                    rnd: jax.Array, allreduce=None, byz_aux=None):
+                    rnd: jax.Array, allreduce=None, byz_aux=None,
+                    trace: LookupTrace | None = None):
     """One adversarial lock-step round: :func:`step_impl` plus the
     Byzantine fault model and the strike/blacklist defense.
 
@@ -1222,22 +1392,31 @@ def chaos_step_impl(ids: jax.Array, alive: jax.Array,
         # A reply carrying any contradicted claim is a poisoned
         # exchange, attributable to its responder.
         malformed = jnp.any(contradicted.reshape(-1, a, k2), axis=2)
+        poison_ct = jnp.sum(contradicted.astype(jnp.int32))
     else:
         malformed = jnp.zeros_like(valid)
+        poison_ct = jnp.int32(0)
 
     # Shared round tail: dead solicitations evict via ~sel_alive;
     # poisoned/blacklisted response slots were invalidated above, and
     # convicted RESPONDERS leave shortlists at the next round's
     # blacklist eviction (plus the final _censor_convicted pass).
-    new_st = _merge_round(st, cfg, sel, sel_alive, answered, resp,
-                          resp_d0)
+    merged = _merge_round(st, cfg, sel, sel_alive, answered, resp,
+                          resp_d0, trace=trace, rnd=rnd)
+    if trace is None:
+        new_st = merged
+    else:
+        new_st, trace = merged
+        trace = trace._replace(
+            poison=trace.poison.at[rnd].add(poison_ct, mode="drop"))
 
     # --- strike accounting (see the docstring's defense contract).
     # Undefended runs skip it entirely: strikes would drive nothing,
     # and the per-round [N] scatters (+ mesh all-reduces) are pure
     # waste there.
     if not defend:
-        return new_st, strikes
+        return ((new_st, strikes) if trace is None
+                else (new_st, strikes, trace))
     succ = sel_alive & answered & ~malformed
     oob = jnp.int32(n)
     succ_ct = jnp.zeros((n,), jnp.int32).at[
@@ -1259,9 +1438,21 @@ def chaos_step_impl(ids: jax.Array, alive: jax.Array,
     # per exchange.  Conviction is permanent for the lifetime of the
     # batch — shorter than the host twin's 10-minute sentence; fresh
     # batches start clean.
-    strikes = jnp.where(succ_ct > 0, 0,
-                        strikes + jnp.minimum(drop_ct, 1)) + lie_ct
-    return new_st, strikes
+    new_strikes = jnp.where(succ_ct > 0, 0,
+                            strikes + jnp.minimum(drop_ct, 1)) + lie_ct
+    if trace is None:
+        return new_st, new_strikes
+    # Strike/conviction telemetry is computed AFTER the (possibly
+    # psum-reduced) strike merge, so the numbers are replicated across
+    # shards — the sharded reducer takes pmax of these rows, not psum.
+    trace = trace._replace(
+        strikes=trace.strikes.at[rnd].add(
+            jnp.sum(jnp.maximum(new_strikes - strikes, 0)),
+            mode="drop"),
+        convictions=trace.convictions.at[rnd].set(
+            jnp.sum((new_strikes >= faults.strike_limit
+                     ).astype(jnp.int32)), mode="drop"))
+    return new_st, new_strikes, trace
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -1279,16 +1470,17 @@ def chaos_lookup_init(swarm: Swarm, cfg: SwarmConfig,
 def chaos_lookup_step(swarm: Swarm, cfg: SwarmConfig,
                       faults: LookupFaults, st: LookupState,
                       strikes: jax.Array, rnd: jax.Array,
-                      byz_aux=None):
+                      byz_aux=None, trace: LookupTrace | None = None):
     return chaos_step_impl(swarm.ids, swarm.alive, swarm.byzantine,
                            _local_respond(swarm, cfg), cfg, faults,
-                           st, strikes, rnd, byz_aux=byz_aux)
+                           st, strikes, rnd, byz_aux=byz_aux,
+                           trace=trace)
 
 
 def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                  key: jax.Array,
-                 faults: LookupFaults = LookupFaults()
-                 ) -> tuple[LookupResult, jax.Array]:
+                 faults: LookupFaults = LookupFaults(),
+                 collect_trace: bool = False):
     """Run a batch of lookups to completion UNDER the adversarial
     fault model (Byzantine responders + exchange loss) with the
     strike/blacklist defense — the lookup-path twin of the storage
@@ -1300,7 +1492,9 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     the attacker).  Returns ``(LookupResult, strikes [N] int32)`` —
     ``strikes >= faults.strike_limit`` is the conviction mask, which
     benches report as true/false-conviction rates against
-    ``swarm.byzantine``.
+    ``swarm.byzantine``.  ``collect_trace=True`` turns the flight
+    recorder on and returns ``(result, strikes, LookupTrace)`` —
+    capture rides the loop carry, adding no host syncs.
     """
     l = targets.shape[0]
     honest_alive = (swarm.alive if swarm.byzantine is None
@@ -1311,14 +1505,22 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     byz_aux = (byz_colluder_pool(swarm.byzantine)
                if faults.eclipse and swarm.byzantine is not None
                else None)
-    st, strikes = run_burst_loop(
-        lambda c, r: chaos_lookup_step(swarm, cfg, faults, c[0], c[1],
-                                       jnp.int32(r), byz_aux),
-        (st, strikes), cfg, done_of=lambda c: c[0].done)
+    if collect_trace:
+        st, strikes, trace = run_burst_loop(
+            lambda c, r: chaos_lookup_step(swarm, cfg, faults, c[0],
+                                           c[1], jnp.int32(r), byz_aux,
+                                           trace=c[2]),
+            (st, strikes, empty_lookup_trace(cfg)), cfg,
+            done_of=lambda c: c[0].done)
+    else:
+        st, strikes = run_burst_loop(
+            lambda c, r: chaos_lookup_step(swarm, cfg, faults, c[0],
+                                           c[1], jnp.int32(r), byz_aux),
+            (st, strikes), cfg, done_of=lambda c: c[0].done)
     found = _finalize(swarm.ids, st, cfg)
     found = _censor_convicted(found, strikes, cfg, faults)
-    return (LookupResult(found=found, hops=st.hops, done=st.done),
-            strikes)
+    res = LookupResult(found=found, hops=st.hops, done=st.done)
+    return (res, strikes, trace) if collect_trace else (res, strikes)
 
 
 def _censor_convicted(found: jax.Array, strikes: jax.Array,

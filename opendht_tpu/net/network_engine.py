@@ -51,6 +51,7 @@ from ..core.scheduler import Scheduler
 from ..core.value import Query, Value
 from ..utils.infohash import InfoHash
 from ..utils.logger import NONE, Logger
+from ..utils.metrics import MetricsRegistry
 from ..utils.rate_limiter import RateLimiter, make_rate_limiter
 from ..utils.sockaddr import AF_INET, AF_INET6, SockAddr
 from .request import Request, RequestState
@@ -61,6 +62,18 @@ from .wire import (MessageBuilder, MessageType, ParsedMessage, make_tid,
                    ANNOUNCE_VALUE, REFRESH, LISTEN, WANT4, WANT6)
 
 SEND_NODES = 8  # nodes per reply (ref: src/network_engine.cpp:58)
+
+# Canonical message-type taxonomy for the per-type counters (ref:
+# network_engine.h:509-516 keeps one enum-indexed array per direction).
+# Request keys are the METHODS names — identical for inbound (wire "q"
+# strings) and outbound, so stats_in/stats_out finally share ONE key
+# set; replies/errors count under "reply"/"error" in BOTH directions
+# (the previous code only counted the inbound side and keyed inbound
+# requests on the RAW wire string, handing an attacker unbounded
+# counter-key cardinality); fragmentation part packets count as
+# "value_parts"; anything unrecognized folds into "other".
+CANONICAL_TYPES = tuple(name for name, _ in METHODS.values()) + (
+    "reply", "error", "value_parts", "other")
 
 
 class DhtProtocolException(Exception):
@@ -135,7 +148,8 @@ class NetworkEngine:
                  transport4: Optional[DatagramTransport],
                  transport6: Optional[DatagramTransport],
                  scheduler: Scheduler, handler, cache: NodeCache,
-                 logger: Logger = NONE, rng: Optional[random.Random] = None):
+                 logger: Logger = NONE, rng: Optional[random.Random] = None,
+                 metrics: Optional[MetricsRegistry] = None):
         self.myid = myid
         self.network = network
         self.scheduler = scheduler
@@ -165,9 +179,19 @@ class NetworkEngine:
         self.partial_messages: Dict[bytes, PartialMessage] = {}
         self._rx_job = None
 
-        # per-message-type counters in/out (ref: network_engine.h:509-516)
-        self.stats_in: Dict[str, int] = {}
-        self.stats_out: Dict[str, int] = {}
+        # Per-message-type counters in/out (ref: network_engine.h:
+        # 509-516), now registry-backed so the Prometheus/JSON surface
+        # and the legacy stats_in/stats_out dict views read ONE source
+        # of truth.  Keys are CANONICAL_TYPES only.
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._msg_ctr = self.metrics.counter(
+            "dht_net_messages_total",
+            "DHT wire messages by direction and canonical type",
+            ("dir", "type"))
+        self._drop_ctr = self.metrics.counter(
+            "dht_net_dropped_total",
+            "Inbound packets dropped before dispatch",
+            ("reason",))
 
     # ------------------------------------------------------------------ #
     # sending                                                            #
@@ -187,8 +211,31 @@ class NetworkEngine:
         if t is not None:
             t.send(data, dest)
 
-    def _count(self, stats: Dict[str, int], key: str) -> None:
-        stats[key] = stats.get(key, 0) + 1
+    def _count(self, direction: str, key: str) -> None:
+        """Count one wire message under the canonical taxonomy (raw
+        wire strings fold into "other" — counter keys must stay a
+        CLOSED set, never attacker-chosen)."""
+        if key not in CANONICAL_TYPES:
+            key = "other"
+        self._msg_ctr.inc(dir=direction, type=key)
+
+    @property
+    def stats_in(self) -> Dict[str, int]:
+        """Legacy dict view of the inbound counters (canonical keys)."""
+        return self._stats_dict("in")
+
+    @property
+    def stats_out(self) -> Dict[str, int]:
+        """Legacy dict view of the outbound counters (canonical keys)."""
+        return self._stats_dict("out")
+
+    def _stats_dict(self, direction: str) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for labels, value in self._msg_ctr.series():
+            d = dict(labels)
+            if d.get("dir") == direction:
+                out[d["type"]] = int(value)
+        return out
 
     def _send_request(self, method: int, node: Node, msg_for_tid, on_done,
                       on_expired) -> Request:
@@ -197,7 +244,7 @@ class NetworkEngine:
         req = Request(tid, node, msg, on_done, on_expired)
         self.requests[tid] = req
         node.requested(req)
-        self._count(self.stats_out, METHODS[method][0])
+        self._count("out", METHODS[method][0])
         self._request_step(req)
         return req
 
@@ -332,6 +379,7 @@ class NetworkEngine:
             r["token"] = ntoken
         if expired:
             r["exp"] = True
+        self._count("out", "reply")  # listen pushes ride reply envelopes
         if total < MAX_PACKET_VALUE_SIZE and len(values) <= MAX_MESSAGE_VALUE_COUNT:
             r["values"] = packed
             env = {"r": r, "t": socket_id, "y": "r", "v": "RNG1"}
@@ -399,8 +447,10 @@ class NetworkEngine:
 
     def process_message(self, data: bytes, from_addr: SockAddr) -> None:
         if self._is_martian(from_addr):
+            self._drop_ctr.inc(reason="martian")
             return
         if self.is_node_blacklisted(from_addr):
+            self._drop_ctr.inc(reason="blacklist")
             return
         if not data:
             return
@@ -408,13 +458,16 @@ class NetworkEngine:
             msg = parse_message(data)
         except Exception:
             self.log.w("can't parse message from %s", from_addr)
+            self._drop_ctr.inc(reason="parse")
             return
         now = self.scheduler.time()
 
         if msg.network != self.network:
+            self._drop_ctr.inc(reason="network_mismatch")
             return  # ref: :387-390
 
         if msg.type == MessageType.ValueData:
+            self._count("in", "value_parts")
             pm = self.partial_messages.get(msg.tid)
             if pm is not None and pm.from_addr == from_addr:
                 pm.append(msg.part_offset, msg.part_data, now)
@@ -424,16 +477,20 @@ class NetworkEngine:
             return
 
         if msg.id == self.myid:
+            self._drop_ctr.inc(reason="self_message")
             return  # self-message drop (ref: :421)
 
         is_request = msg.type not in (MessageType.Error, MessageType.Reply)
         if is_request:
             # rate limits apply to requests only (ref: :287-305)
             if not self._rate_limit_ok(from_addr, now):
+                self._drop_ctr.inc(reason="rate_limit")
                 return
-            self._count(self.stats_in, msg.type or "?")
+            # One canonical key per wire method — the raw "q" string is
+            # never a counter key (unknown methods fold into "other").
+            self._count("in", msg.type or "other")
         else:
-            self._count(self.stats_in, "reply" if msg.type == MessageType.Reply
+            self._count("in", "reply" if msg.type == MessageType.Reply
                         else "error")
 
         if msg.value_parts_total and not msg.values:
@@ -595,7 +652,11 @@ class NetworkEngine:
                            from_addr)
             else:
                 self.log.w("unknown query type %r", msg.type)
+                return
+            # Every handled request above answered with one reply.
+            self._count("out", "reply")
         except DhtProtocolException as e:
+            self._count("out", "error")
             self._send(self.builder.error(msg.tid, e.code, e.message,
                                           include_id=True), from_addr)
 

@@ -35,6 +35,7 @@ from ..models.swarm import (
     LookupFaults,
     LookupResult,
     LookupState,
+    LookupTrace,
     Swarm,
     SwarmConfig,
     _finalize,
@@ -49,6 +50,7 @@ from ..models.swarm import (
     byz_colluder_pool,
     chaos_step_impl,
     device_hbm_bytes,
+    empty_lookup_trace,
     init_impl,
     lookup,
     run_burst_loop,
@@ -438,11 +440,96 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# flight recorder on the routed multi-chip path
+# ---------------------------------------------------------------------------
+
+# Trace fields that are per-shard PARTIAL sums (each shard counts its
+# own lookup sub-batch) reduce with psum; fields computed from already-
+# replicated state reduce with pmax — the chaos strike counters are
+# psum-merged every round, so per-round strike/conviction telemetry is
+# identical on every shard, and psum would multiply it by the mesh
+# size.  ``rounds`` is lock-step-identical too.
+_TRACE_PMAX_FIELDS = ("strikes", "convictions", "rounds")
+
+
+def _trace_allreduce(trace: LookupTrace) -> LookupTrace:
+    """ONE reduction of the whole trace at loop exit (inside
+    shard_map): the result is replicated, so the caller's out_spec is
+    ``P()`` and the host sees a single global trace."""
+    return LookupTrace(*[
+        jax.lax.pmax(v, AXIS) if f in _TRACE_PMAX_FIELDS
+        else jax.lax.psum(v, AXIS)
+        for f, v in zip(LookupTrace._fields, trace)])
+
+
+def _trace_specs():
+    return LookupTrace(*[P() for _ in LookupTrace._fields])
+
+
+def _traced_sharded_body(cfg: SwarmConfig, n_shards: int,
+                         capacity_factor: float, ids, tables_local,
+                         alive, targets, key):
+    """:func:`_sharded_body` with the flight recorder riding the
+    while-loop carry — counters accumulate per shard inside the loop
+    and all-reduce ONCE at exit (zero extra host syncs, zero extra
+    collectives on the per-round path)."""
+    ll = targets.shape[0]
+    me = jax.lax.axis_index(AXIS)
+    key = jax.random.fold_in(key, me)
+    origins = _sample_origins(key, alive, ll)
+    respond_init, respond = _make_responders(
+        cfg, n_shards, capacity_factor, False, ids, tables_local, alive)
+    st = init_impl(ids, respond_init, cfg, targets, origins)
+    trace = empty_lookup_trace(cfg)
+
+    def cond(carry):
+        st, _, it = carry
+        pending = jax.lax.psum(jnp.sum(~st.done), AXIS)
+        return (pending > 0) & (it < cfg.max_steps)
+
+    def body(carry):
+        st, trace, it = carry
+        st, trace = step_impl(ids, alive, respond, cfg, st,
+                              trace=trace, rnd=it)
+        return st, trace, it + 1
+
+    st, trace, _ = jax.lax.while_loop(cond, body,
+                                      (st, trace, jnp.int32(0)))
+    return (_finalize(ids, st, cfg), st.hops, st.done,
+            _trace_allreduce(trace))
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor"))
+def traced_sharded_lookup(swarm: Swarm, cfg: SwarmConfig,
+                          targets: jax.Array, key: jax.Array,
+                          mesh: Mesh, capacity_factor: float = 2.0
+                          ) -> tuple[LookupResult, LookupTrace]:
+    """Table-sharded lookups with the flight recorder on: returns
+    ``(result, LookupTrace)`` with the trace psum/pmax-reduced across
+    shards (replicated output).  Uses the while-loop formulation only —
+    like :func:`chaos_sharded_lookup`, the recorder is a diagnostics
+    tool for validation-scale runs, not the 10M-node burst dispatcher.
+    """
+    n_shards = mesh.shape[AXIS]
+    fn = shard_map(
+        partial(_traced_sharded_body, cfg, n_shards, capacity_factor),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS, None), P(), P(AXIS, None), P()),
+        out_specs=(P(AXIS, None), P(AXIS), P(AXIS), _trace_specs()),
+        check_vma=False,
+    )
+    found, hops, done, trace = fn(swarm.ids, swarm.tables, swarm.alive,
+                                  targets, key)
+    return LookupResult(found=found, hops=hops, done=done), trace
+
+
+# ---------------------------------------------------------------------------
 # adversarial lookups on the routed multi-chip path
 # ---------------------------------------------------------------------------
 
 def _chaos_sharded_body(cfg: SwarmConfig, n_shards: int,
                         capacity_factor: float, faults: LookupFaults,
+                        collect_trace: bool,
                         ids, tables_local, alive, byzantine, targets,
                         key):
     """Per-device chaos lookup loop: the shared adversarial round
@@ -475,36 +562,53 @@ def _chaos_sharded_body(cfg: SwarmConfig, n_shards: int,
     # the [N] argsort runs once per program, not once per round.
     byz_aux = (byz_colluder_pool(byzantine) if faults.eclipse
                else None)
+    trace0 = empty_lookup_trace(cfg) if collect_trace else None
 
     def cond(carry):
-        st, _, it = carry
+        st = carry[0]
+        it = carry[-1]
         pending = jax.lax.psum(jnp.sum(~st.done), AXIS)
         return (pending > 0) & (it < cfg.max_steps)
 
-    def body(carry):
-        st, strikes, it = carry
-        st, strikes = chaos_step_impl(
-            ids, alive, byzantine, respond, cfg, faults, st, strikes,
-            it, allreduce=allreduce, byz_aux=byz_aux)
-        return st, strikes, it + 1
+    if collect_trace:
+        def body(carry):
+            st, strikes, trace, it = carry
+            st, strikes, trace = chaos_step_impl(
+                ids, alive, byzantine, respond, cfg, faults, st,
+                strikes, it, allreduce=allreduce, byz_aux=byz_aux,
+                trace=trace)
+            return st, strikes, trace, it + 1
 
-    st, strikes, _ = jax.lax.while_loop(
-        cond, body, (st, strikes, jnp.int32(0)))
+        st, strikes, trace, _ = jax.lax.while_loop(
+            cond, body, (st, strikes, trace0, jnp.int32(0)))
+        trace = _trace_allreduce(trace)
+    else:
+        def body(carry):
+            st, strikes, it = carry
+            st, strikes = chaos_step_impl(
+                ids, alive, byzantine, respond, cfg, faults, st,
+                strikes, it, allreduce=allreduce, byz_aux=byz_aux)
+            return st, strikes, it + 1
+
+        st, strikes, _ = jax.lax.while_loop(
+            cond, body, (st, strikes, jnp.int32(0)))
     # Last-round convictions would otherwise survive in done heads
     # (eviction runs at the start of the NEXT round, which the loop
     # exit skips) — censor reported results like the local engine.
     found = _censor_convicted(_finalize(ids, st, cfg), strikes, cfg,
                               faults)
+    if collect_trace:
+        return found, st.hops, st.done, strikes, trace
     return found, st.hops, st.done, strikes
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "faults",
-                                   "capacity_factor"))
+                                   "capacity_factor", "collect_trace"))
 def chaos_sharded_lookup(swarm: Swarm, cfg: SwarmConfig,
                          targets: jax.Array, key: jax.Array, mesh: Mesh,
                          faults: LookupFaults = LookupFaults(),
-                         capacity_factor: float = 2.0
-                         ) -> tuple[LookupResult, jax.Array]:
+                         capacity_factor: float = 2.0,
+                         collect_trace: bool = False):
     """Table-sharded adversarial lookups: :func:`sharded_lookup` under
     the Byzantine fault model, with mesh-wide strike/blacklist state.
 
@@ -515,22 +619,30 @@ def chaos_sharded_lookup(swarm: Swarm, cfg: SwarmConfig,
     synchronised while-loop formulation only: chaos scenarios run at
     sizes whose per-shard table fits twice in HBM (the 10M-node burst
     dispatcher is a throughput tool, not a fault harness).  Returns
-    ``(LookupResult, strikes [N])``.
+    ``(LookupResult, strikes [N])``, plus a mesh-reduced
+    :class:`~opendht_tpu.models.swarm.LookupTrace` when
+    ``collect_trace`` is set.
     """
     n_shards = mesh.shape[AXIS]
     byz = (swarm.byzantine if swarm.byzantine is not None
            else jnp.zeros((cfg.n_nodes,), bool))
+    out_specs = (P(AXIS, None), P(AXIS), P(AXIS), P())
+    if collect_trace:
+        out_specs = out_specs + (_trace_specs(),)
     fn = shard_map(
         partial(_chaos_sharded_body, cfg, n_shards, capacity_factor,
-                faults),
+                faults, collect_trace),
         mesh=mesh,
         in_specs=(P(), P(AXIS, None), P(), P(), P(AXIS, None), P()),
-        out_specs=(P(AXIS, None), P(AXIS), P(AXIS), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
-    found, hops, done, strikes = fn(swarm.ids, swarm.tables,
-                                    swarm.alive, byz, targets, key)
-    return LookupResult(found=found, hops=hops, done=done), strikes
+    out = fn(swarm.ids, swarm.tables, swarm.alive, byz, targets, key)
+    found, hops, done, strikes = out[:4]
+    res = LookupResult(found=found, hops=hops, done=done)
+    if collect_trace:
+        return res, strikes, out[4]
+    return res, strikes
 
 
 # ---------------------------------------------------------------------------

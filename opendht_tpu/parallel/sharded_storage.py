@@ -40,6 +40,7 @@ from ..models.storage import (
     AnnounceReport,
     GetResult,
     StoreConfig,
+    StoreTrace,
     SwarmStore,
     _key_match,
     _key_write,
@@ -176,7 +177,9 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     ``full_capacity_factor`` (a maintenance sweep expects most
     replicas to refresh, so the full phase can be provisioned far
     below the probe phase; needy requests past its capacity retry next
-    sweep).  Returns ``(store_local, replicas [ll])``.  The exchange's
+    sweep).  Returns ``(store_local, replicas [ll], StoreTrace)`` —
+    the trace leaves are psum-reduced here (one stacked [5] psum), so
+    every shard holds the mesh-global sweep counters.  The exchange's
     wire cost is fully static — capacity buckets ship full-size
     regardless of fill — so the traffic accounting lives in
     :func:`storage_wire_words`, not on the device.
@@ -245,7 +248,7 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
             if w and payloads is not None else None)
     # req_put = flat request index → _store_insert's replica vector
     # becomes a per-request accept bit we can route back.
-    store_local, acc = _store_insert(
+    store_local, acc, trace = _store_insert(
         store_local, scfg, r_node, r_key, r_val, r_seq,
         jnp.arange(m, dtype=jnp.int32), now,
         jnp.maximum(r_size, 1), r_ttl, r_pl)
@@ -259,7 +262,11 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
                        axis=1, dtype=jnp.int32)
 
     store_local = _merge_listener_state(store_local)
-    return store_local, replicas
+    # Mesh-global sweep telemetry: one stacked psum of the five scalar
+    # counters — replicated, so the jit wrapper exposes it with P().
+    tv = jax.lax.psum(jnp.stack(list(trace)), AXIS)
+    trace = StoreTrace(*[tv[i] for i in range(len(trace))])
+    return store_local, replicas, trace
 
 
 def storage_wire_words(cfg: SwarmConfig, scfg: StoreConfig,
@@ -424,11 +431,12 @@ def _sharded_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                               probe=probe,
                               full_capacity_factor=full_capacity_factor)
 
+    trace_specs = StoreTrace(*[P() for _ in StoreTrace._fields])
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(), specs, P(AXIS, None), P(AXIS, None), P(AXIS),
                   P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P()),
-        out_specs=(specs, P(AXIS)), check_vma=False)
+        out_specs=(specs, P(AXIS), trace_specs), check_vma=False)
     return fn(swarm.alive, store, found, keys, vals, seqs, sizes, ttls,
               payloads, jnp.uint32(now))
 
@@ -474,12 +482,12 @@ def sharded_announce(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
         payloads = jnp.zeros((p, scfg.payload_words), jnp.uint32)
     res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
     found = drop_exchanges(res.found, drop_frac, drop_key)
-    store, replicas = _sharded_insert(
+    store, replicas, trace = _sharded_insert(
         swarm, cfg, store, scfg, found, keys, vals, seqs, sizes,
         ttls, payloads, now, mesh, capacity_factor, probe,
         full_capacity_factor)
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
-                                 done=res.done)
+                                 done=res.done, trace=trace)
 
 
 @partial(jax.jit,
@@ -577,6 +585,7 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     while n % cn:
         cn -= n_shards
     reps, hops, done = [], [], []
+    trace = StoreTrace.zeros()
     for i, nlo in enumerate(range(lo0, hi0, cn)):
         nsl = slice(nlo, nlo + cn)
         keys = store.keys[nlo * s * N_LIMBS:
@@ -592,7 +601,7 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
         found = drop_exchanges(
             found, drop_frac,
             None if drop_key is None else jax.random.fold_in(drop_key, i))
-        store, replicas = _sharded_insert(
+        store, replicas, tr = _sharded_insert(
             swarm, cfg, store, scfg, found, keys,
             store.vals[nsl].reshape(-1), store.seqs[nsl].reshape(-1),
             store.sizes[nsl].reshape(-1), store.ttls[nsl].reshape(-1),
@@ -601,10 +610,12 @@ def sharded_republish(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                           ].reshape(cn * s, scfg.payload_words),
             now, mesh,
             capacity_factor, probe, full_capacity_factor)
+        trace = trace + tr
         reps.append(replicas), hops.append(res.hops), done.append(res.done)
     return store, AnnounceReport(replicas=jnp.concatenate(reps),
                                  hops=jnp.concatenate(hops),
-                                 done=jnp.concatenate(done))
+                                 done=jnp.concatenate(done),
+                                 trace=trace)
 
 
 def sharded_expire(store: SwarmStore, scfg: StoreConfig,

@@ -438,6 +438,23 @@ class DhtRunner:
     def get_nodes_stats(self, af: int = AF_INET):
         return self.dht.get_nodes_stats(af)
 
+    def get_node_stats(self, af: int = AF_INET):
+        """Full ``NodeStats`` snapshot (good/dubious/cached/incoming
+        node counts, live searches, storage keys/values/bytes) — the
+        runner-level mirror of the reference ``DhtRunner::getNodesStats``
+        returning the ``NodeStats`` struct."""
+        return self.dht.node_stats(af)
+
+    def get_stats(self):
+        """``(stats_in, stats_out)`` canonical per-message-type wire
+        counters (see net.network_engine.CANONICAL_TYPES)."""
+        return self.dht.engine.get_stats()
+
+    @property
+    def metrics(self):
+        """The node's MetricsRegistry (None before :meth:`run`)."""
+        return self.dht.metrics if self.dht is not None else None
+
     def get_public_address(self, af: int = 0):
         return self.dht.get_public_address(af)
 

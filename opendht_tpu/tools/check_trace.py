@@ -1,0 +1,116 @@
+"""Validate a ``bench.py --trace-out`` flight-recorder artifact.
+
+The gate's trace leg runs a small-N bench with the recorder on, then
+this checker proves the artifact is USABLE — it parses, the per-round
+counters are shape-consistent and monotone where the semantics demand
+it, and the trace agrees with the BENCH row it rode along with (the
+degradation numbers must be explainable FROM the trace, or the
+recorder is decoration).  Exit 0 on success; exit 1 with one line per
+violation otherwise.
+
+    python -m opendht_tpu.tools.check_trace /tmp/trace.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+COUNTERS = ("requests", "replies", "drops", "poison", "strikes",
+            "convictions", "churn", "done")
+
+
+def check_trace_obj(obj: dict) -> List[str]:
+    """All violations found in a loaded trace artifact (empty = pass)."""
+    errs: List[str] = []
+    for field in ("kind", "bench", "trace", "hop_histogram"):
+        if field not in obj:
+            errs.append(f"missing top-level field {field!r}")
+    if errs:
+        return errs
+    bench, trace, hist = obj["bench"], obj["trace"], obj["hop_histogram"]
+
+    rounds = trace.get("rounds", 0)
+    n_lookups = trace.get("n_lookups") or bench.get("n_lookups", 0)
+    if rounds < 1:
+        errs.append(f"trace recorded {rounds} rounds; expected >= 1")
+    if not 0 < rounds <= trace.get("max_steps", 0):
+        errs.append(f"rounds {rounds} outside (0, max_steps "
+                    f"{trace.get('max_steps')}]")
+
+    counters = trace.get("counters", {})
+    for name in COUNTERS:
+        row = counters.get(name)
+        if row is None:
+            errs.append(f"counter {name!r} missing")
+            continue
+        if len(row) != rounds:
+            errs.append(f"counter {name!r} has {len(row)} rows for "
+                        f"{rounds} rounds")
+        if any(v < 0 for v in row):
+            errs.append(f"counter {name!r} went negative: {row}")
+    if errs:
+        return errs
+
+    # Semantics-mandated monotonicity/consistency:
+    done = counters["done"]
+    if any(b < a for a, b in zip(done, done[1:])):
+        errs.append(f"done gauge not monotone: {done}")
+    if counters["requests"][0] <= 0:
+        errs.append("round 0 issued no solicitations")
+    for r, (d, req) in enumerate(zip(counters["drops"],
+                                     counters["requests"])):
+        if d > req:
+            errs.append(f"round {r}: drops {d} > requests {req}")
+
+    # Cross-check against the bench row the trace must explain.  The
+    # chaos-lookup mode nests its traced leg's numbers under
+    # bench["headline"] (the trace rides that leg), so fall back there
+    # — otherwise chaos artifacts would skip these checks entirely.
+    headline = bench.get("headline")
+    row = headline if isinstance(headline, dict) else {}
+    if n_lookups:
+        final_frac = done[-1] / n_lookups
+        reported = bench.get("done_frac", row.get("done_frac"))
+        if reported is not None and abs(final_frac - reported) > 1e-6:
+            errs.append(f"trace final done_frac {final_frac:.6f} != "
+                        f"bench done_frac {reported:.6f}")
+        if sum(hist) != n_lookups:
+            errs.append(f"hop histogram sums to {sum(hist)}, expected "
+                        f"{n_lookups} lookups")
+    # A usable recall needs converged lookups; a trace whose done gauge
+    # never moved cannot explain any recall > 0.
+    recall = bench.get("recall_at_8", row.get("recall_at_8"))
+    if recall and recall > 0 and done[-1] == 0:
+        errs.append(f"bench reports recall {recall} but the trace saw "
+                    f"0 lookups converge")
+    return errs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print(__doc__)
+        return 2
+    path = argv[0]
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_trace: cannot load {path}: {e}")
+        return 1
+    errs = check_trace_obj(obj)
+    if errs:
+        for e in errs:
+            print(f"check_trace: {e}")
+        return 1
+    t = obj["trace"]
+    print(f"check_trace: OK — {t['rounds']} rounds, "
+          f"{t['counters']['requests'][0]} round-0 requests, "
+          f"final done {t['counters']['done'][-1]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

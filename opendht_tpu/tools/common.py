@@ -8,8 +8,6 @@ import sys
 import time
 from typing import Optional, Tuple
 
-from ..crypto.identity import generate_identity
-from ..runtime import DhtRunner
 from ..utils.logger import NONE, Logger
 
 DEFAULT_PORT = 4222  # ref: tools/tools_common.h:108
@@ -40,13 +38,19 @@ def add_common_args(ap: argparse.ArgumentParser) -> None:
     ap.add_argument("--bind", default="0.0.0.0")
 
 
-def start_node(args) -> DhtRunner:
+def start_node(args) -> "DhtRunner":
     from ..core.dht import DhtConfig
     from ..crypto.securedht import SecureDhtConfig
+    from ..runtime import DhtRunner
     from ..runtime.dhtrunner import DhtRunnerConfig
 
-    identity = generate_identity("dhtnode", key_length=2048) \
-        if args.identity else None
+    identity = None
+    if args.identity:
+        # Imported lazily: the optional `cryptography` dep is only
+        # needed when -i asks for a signing identity — the tools (and
+        # the gateway's /metrics surface) must work without it.
+        from ..crypto.identity import generate_identity
+        identity = generate_identity("dhtnode", key_length=2048)
     cfg = DhtRunnerConfig(SecureDhtConfig(
         DhtConfig(network=args.network), identity))
     runner = DhtRunner(logger=Logger(level=Logger.DEBUG)

@@ -7,6 +7,8 @@ Commands (parity with the reference REPL, tools/dhtnode.cpp:96-140):
   ls                 searches log
   ld                 storage log
   lr                 routing table log
+  stats              node-stats table + wire message counters
+  dump               full dump: routing tables + searches + storage
   b <host[:port]>    bootstrap
   g <key>            get
   p <key> <data>     put
@@ -27,13 +29,52 @@ import sys
 from ..core.value import Value
 from ..indexation.pht import Pht
 from ..utils.infohash import InfoHash
-from ..utils.sockaddr import AF_INET
+from ..utils.sockaddr import AF_INET, AF_INET6
 from .common import (OpTimer, add_common_args, parse_host_port,
                      repl_lines, start_node)
 
 
 def _h(word: str) -> InfoHash:
     return InfoHash(word) if len(word) == 40 else InfoHash.get(word)
+
+
+def format_stats(node) -> str:
+    """Node-stats table + wire counters (the reference ``dhtnode``'s
+    ``ll`` info block, tabulated)."""
+    rows = [("", "good", "dubious", "cached", "incoming", "searches")]
+    for af, name in ((AF_INET, "IPv4"), (AF_INET6, "IPv6")):
+        ns = node.get_node_stats(af)
+        rows.append((name, ns.good_nodes, ns.dubious_nodes,
+                     ns.cached_nodes, ns.incoming_nodes, ns.searches))
+    widths = [max(len(str(r[c])) for r in rows)
+              for c in range(len(rows[0]))]
+    out = [f"Node {node.get_node_id()}"]
+    for r in rows:
+        out.append("  " + "  ".join(
+            str(v).rjust(w) for v, w in zip(r, widths)))
+    ns = node.get_node_stats(AF_INET)
+    out.append(f"  storage: {ns.storage_values} values, "
+               f"{ns.storage_bytes} B in {ns.storage_keys} keys")
+    stats_in, stats_out = node.get_stats()
+    keys = sorted(set(stats_in) | set(stats_out))
+    out.append("  messages (in/out): " + ", ".join(
+        f"{k} {stats_in.get(k, 0)}/{stats_out.get(k, 0)}" for k in keys))
+    return "\n".join(out)
+
+
+def format_dump(node) -> str:
+    """Routing tables + searches + storage — the reference ``ll``+``ld``
+    dumps in one command."""
+    parts = []
+    for af, name in ((AF_INET, "IPv4"), (AF_INET6, "IPv6")):
+        log = node.dht.get_routing_table_log(af)
+        if log:
+            parts.append(f"--- routing table {name} ---\n{log}")
+    searches = node.dht.get_searches_log()
+    if searches:
+        parts.append(f"--- searches ---\n{searches}")
+    parts.append(f"--- storage ---\n{node.dht.get_storage_log()}")
+    return "\n".join(parts)
 
 
 def main(argv=None) -> int:
@@ -72,6 +113,10 @@ def main(argv=None) -> int:
                 print(node.dht.get_storage_log())
             elif op == "lr":
                 print(node.dht.get_routing_table_log(AF_INET))
+            elif op == "stats":
+                print(format_stats(node))
+            elif op == "dump":
+                print(format_dump(node))
             elif op == "b":
                 host, port = parse_host_port(rest[0])
                 node.bootstrap(host, port)

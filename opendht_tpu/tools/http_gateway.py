@@ -3,8 +3,12 @@ Twisted-based gateway in the reference harness).
 
     GET  /<key>          -> JSON list of values stored at the key
     POST /<key>  (body)  -> put the body as a value; 200 on announce
+    GET  /metrics        -> Prometheus text exposition (node metrics)
+    GET  /stats.json     -> NodeStats + wire counters as JSON
 
 Keys are free-form strings (SHA-1 hashed) or 40-char hex infohashes.
+``metrics`` and ``stats.json`` are reserved paths; a DHT key with one
+of those literal names must be queried by its 40-char hex form.
 """
 
 from __future__ import annotations
@@ -18,11 +22,26 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..core.value import Value
 from ..utils.infohash import InfoHash
+from ..utils.sockaddr import AF_INET, AF_INET6
 from .common import add_common_args, start_node
 
 
 def _h(word: str) -> InfoHash:
     return InfoHash(word) if len(word) == 40 else InfoHash.get(word)
+
+
+def node_stats_json(node) -> dict:
+    """JSON-able snapshot for /stats.json: per-af NodeStats + the
+    canonical wire counters."""
+    stats_in, stats_out = node.get_stats()
+    return {
+        "node_id": str(node.get_node_id()),
+        "status": node.get_status() if hasattr(node, "get_status")
+        else None,
+        "ipv4": node.get_node_stats(AF_INET).to_dict(),
+        "ipv6": node.get_node_stats(AF_INET6).to_dict(),
+        "messages": {"in": stats_in, "out": stats_out},
+    }
 
 
 def make_handler(node):
@@ -35,8 +54,40 @@ def make_handler(node):
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_text(self, code: int, text: str,
+                        ctype: str = "text/plain; version=0.0.4") -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
             key = self.path.strip("/")
+            if key == "metrics":
+                # Refresh derived gauges at scrape time so the scrape
+                # reflects the node NOW, not the last maintenance tick.
+                # These are cross-thread diagnostics reads of loop-
+                # thread state (snapshot-copied in update_metrics); a
+                # scrape racing a resize returns 503 and the scraper
+                # simply retries — never a crashed handler.
+                try:
+                    node.dht.update_metrics()
+                    body = node.metrics.render_prometheus()
+                except RuntimeError:
+                    self._reply(503, {"error": "stats race, retry"})
+                    return
+                self._reply_text(200, body)
+                return
+            if key == "stats.json":
+                try:
+                    obj = node_stats_json(node)
+                except RuntimeError:
+                    self._reply(503, {"error": "stats race, retry"})
+                    return
+                self._reply(200, obj)
+                return
             if not key:
                 self._reply(400, {"error": "missing key"})
                 return

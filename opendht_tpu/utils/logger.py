@@ -25,14 +25,28 @@ class Logger:
         self.stream = stream or sys.stderr
         self.enabled = level < Logger.OFF
 
-    def set_filter(self, h: Optional[object]) -> None:
+    def set_filter(self, h: Optional[object],
+                   prefix_len: int = 8) -> None:
         """Only emit messages that mention hash ``h``
-        (ref: log_enable.h:126-173)."""
-        self._filter = str(h) if h else None
+        (ref: log_enable.h:126-173).
+
+        Matching is by the hash's first ``prefix_len`` hex chars: log
+        call sites abbreviate hashes differently (full 40-hex, 8-hex
+        short ids, ...), so the filter compares a configurable prefix —
+        longer prefixes cut false positives in big swarms, shorter ones
+        catch heavily-truncated log forms.  ``prefix_len <= 0`` or a
+        prefix longer than the hash string falls back to the full
+        string.
+        """
+        if h:
+            s = str(h)
+            self._filter = s[:prefix_len] if prefix_len > 0 else s
+        else:
+            self._filter = None
 
     def _log(self, lvl_name: str, fmt: str, *args) -> None:
         msg = (fmt % args) if args else fmt
-        if self._filter is not None and self._filter[:8] not in msg:
+        if self._filter is not None and self._filter not in msg:
             return
         t = time.time()
         ts = time.strftime("%H:%M:%S", time.localtime(t))

@@ -1,0 +1,266 @@
+"""Host-plane metrics registry: counters, gauges, histograms with
+labels, plus Prometheus text exposition and a JSON view.
+
+The reference exposes per-message-type counters
+(ref: include/opendht/network_engine.h:509-516 ``messages_received``/
+``messages_sent`` et al.), ``getNodesStats`` and the ``dumpTables``
+logs, but no uniform surface to read them from; operators scrape logs.
+This module is that missing surface: one registry object shared by the
+network engine and the DHT core, rendered by the HTTP gateway's
+``/metrics`` (Prometheus text exposition format 0.0.4) and
+``/stats.json`` endpoints and by the ``dhtnode`` REPL's ``stats``
+command.
+
+Deliberately dependency-free (no prometheus_client — the container
+pins its dependency set) and threadsafe: the DHT loop thread writes
+while gateway HTTP threads read.  Metric names follow Prometheus
+conventions (``_total`` suffix on counters, base units in names).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(label_names: Sequence[str], labels: Dict[str, str]
+               ) -> LabelKey:
+    if set(labels) != set(label_names):
+        raise ValueError(
+            f"labels {sorted(labels)} != declared {sorted(label_names)}")
+    return tuple((k, str(labels[k])) for k in label_names)
+
+
+def _fmt_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    body = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+    return "{" + body + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class Metric:
+    """Base: a named family of (label-set → value) series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str,
+                 label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._series: Dict[LabelKey, float] = {}
+
+    def _key(self, labels: Dict[str, str]) -> LabelKey:
+        return _label_key(self.label_names, labels)
+
+    def get(self, **labels) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+    def series(self) -> List[Tuple[LabelKey, float]]:
+        with self._lock:
+            return sorted(self._series.items())
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        series = self.series() or ([((), 0.0)] if not self.label_names
+                                   else [])
+        for key, val in series:
+            out.append(f"{self.name}{_fmt_labels(key)} {_fmt_value(val)}")
+        return out
+
+    def to_json(self):
+        series = self.series()
+        if not self.label_names:
+            return series[0][1] if series else 0.0
+        return [{**dict(k), "value": v} for k, v in series]
+
+
+class Counter(Metric):
+    """Monotone counter.  ``inc`` only — a decrease is a bug."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counter increments must be >= 0")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+
+class Gauge(Metric):
+    """Point-in-time value; set/add freely."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def add(self, amount: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+
+class Histogram(Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: each ``le``
+    bucket counts observations ≤ its bound; ``+Inf`` counts all)."""
+
+    kind = "histogram"
+
+    DEFAULT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+
+    def __init__(self, name: str, help: str,
+                 label_names: Sequence[str] = (),
+                 buckets: Optional[Iterable[float]] = None):
+        super().__init__(name, help, label_names)
+        bs = tuple(sorted(buckets if buckets is not None
+                          else self.DEFAULT_BUCKETS))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bs
+        # per label-set: ([counts per bound] + [inf], sum, count)
+        self._h: Dict[LabelKey, Tuple[List[int], float, int]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts, total, n = self._h.get(
+                key, ([0] * (len(self.buckets) + 1), 0.0, 0))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1
+            self._h[key] = (counts, total + value, n + 1)
+
+    def observe_bulk(self, bucket_counts: Sequence[int], total: float,
+                     **labels) -> None:
+        """Merge pre-aggregated per-bucket counts (NON-cumulative, one
+        per bound, overflow last) — how device-side hop histograms are
+        folded in without observing L scalars one by one."""
+        if len(bucket_counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"expected {len(self.buckets) + 1} bucket counts "
+                f"(one per bound + overflow), got {len(bucket_counts)}")
+        key = self._key(labels)
+        with self._lock:
+            counts, tot, n = self._h.get(
+                key, ([0] * (len(self.buckets) + 1), 0.0, 0))
+            cum = 0
+            for i in range(len(self.buckets)):
+                cum += int(bucket_counts[i])
+                counts[i] += cum
+            counts[-1] += cum + int(bucket_counts[-1])
+            self._h[key] = (counts, tot + total,
+                            n + cum + int(bucket_counts[-1]))
+
+    def snapshot(self) -> List[Tuple[LabelKey, Tuple[List[int], float, int]]]:
+        with self._lock:
+            return sorted((k, (list(c), s, n))
+                          for k, (c, s, n) in self._h.items())
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        snaps = self.snapshot() or (
+            [((), ([0] * (len(self.buckets) + 1), 0.0, 0))]
+            if not self.label_names else [])
+        for key, (counts, total, n) in snaps:
+            for i, b in enumerate(self.buckets):
+                lk = key + (("le", _fmt_value(b)),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} "
+                           f"{counts[i]}")
+            lk = key + (("le", "+Inf"),)
+            out.append(f"{self.name}_bucket{_fmt_labels(lk)} {counts[-1]}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} "
+                       f"{_fmt_value(total)}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {n}")
+        return out
+
+    def to_json(self):
+        out = []
+        for key, (counts, total, n) in self.snapshot():
+            out.append({**dict(key),
+                        "buckets": {**{_fmt_value(b): counts[i]
+                                       for i, b in enumerate(self.buckets)},
+                                    "+Inf": counts[-1]},
+                        "sum": total, "count": n})
+        if not self.label_names:
+            return out[0] if out else {"buckets": {}, "sum": 0.0,
+                                       "count": 0}
+        return out
+
+
+class MetricsRegistry:
+    """Named metric families; idempotent getters (the second
+    ``counter(name)`` call returns the first's object — the engine and
+    core share one registry without coordinating construction order)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Metric] = {}
+
+    def _get_or_make(self, cls, name: str, help: str, label_names,
+                     **kw) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or \
+                        m.label_names != tuple(label_names):
+                    raise ValueError(
+                        f"metric {name!r} re-registered with a different "
+                        f"type or label set")
+                return m
+            m = cls(name, help, label_names, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                label_names: Sequence[str] = ()) -> Counter:
+        return self._get_or_make(Counter, name, help, label_names)
+
+    def gauge(self, name: str, help: str = "",
+              label_names: Sequence[str] = ()) -> Gauge:
+        return self._get_or_make(Gauge, name, help, label_names)
+
+    def histogram(self, name: str, help: str = "",
+                  label_names: Sequence[str] = (),
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        return self._get_or_make(Histogram, name, help, label_names,
+                                 buckets=buckets)
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def collect(self) -> List[Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4), newline-terminated."""
+        lines: List[str] = []
+        for m in self.collect():
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {m.name: m.to_json() for m in self.collect()}

@@ -1,0 +1,117 @@
+"""Logger: per-InfoHash filter (configurable prefix length), level
+gating, and the NONE logger being zero-cost."""
+
+import io
+
+import pytest
+
+from opendht_tpu.utils.infohash import InfoHash
+from opendht_tpu.utils.logger import NONE, Logger
+
+
+def make_logger(level=Logger.DEBUG):
+    stream = io.StringIO()
+    return Logger("t", level=level, stream=stream), stream
+
+
+H = InfoHash.get("filter-me")
+
+
+class TestInfoHashFilter:
+    def test_filter_hit_default_prefix(self):
+        log, out = make_logger()
+        log.set_filter(H)
+        log.d("traffic for %s arrived", str(H)[:8])
+        assert str(H)[:8] in out.getvalue()
+
+    def test_filter_miss_suppresses(self):
+        log, out = make_logger()
+        log.set_filter(H)
+        log.d("traffic for some other hash")
+        log.w("warning about nothing relevant")
+        assert out.getvalue() == ""
+
+    def test_filter_prefix_length_configurable(self):
+        full = str(H)
+        # A message carrying only 4 hex chars of the hash: invisible at
+        # the default 8-char prefix, visible at a 4-char one.
+        log, out = make_logger()
+        log.set_filter(H)
+        log.d("short id %s", full[:4])
+        assert out.getvalue() == ""
+        log.set_filter(H, prefix_len=4)
+        log.d("short id %s", full[:4])
+        assert full[:4] in out.getvalue()
+
+    def test_longer_prefix_cuts_false_positives(self):
+        full = str(H)
+        near_miss = full[:8] + ("0" if full[8] != "0" else "1")
+        log, out = make_logger()
+        log.set_filter(H, prefix_len=9)
+        log.d("collision-ish %s", near_miss)
+        assert out.getvalue() == ""
+        log.d("the real one %s", full[:9])
+        assert full[:9] in out.getvalue()
+
+    def test_nonpositive_prefix_means_full_hash(self):
+        log, out = make_logger()
+        log.set_filter(H, prefix_len=0)
+        log.d("prefix only: %s", str(H)[:20])
+        assert out.getvalue() == ""
+        log.d("full mention: %s", str(H))
+        assert str(H) in out.getvalue()
+
+    def test_clear_filter(self):
+        log, out = make_logger()
+        log.set_filter(H)
+        log.set_filter(None)
+        log.d("anything goes")
+        assert "anything goes" in out.getvalue()
+
+
+class TestLevelGating:
+    def test_levels(self):
+        for level, visible in ((Logger.DEBUG, {"d", "w", "e"}),
+                               (Logger.WARN, {"w", "e"}),
+                               (Logger.ERROR, {"e"}),
+                               (Logger.OFF, set())):
+            log, out = make_logger(level)
+            log.d("msg-d")
+            log.w("msg-w")
+            log.e("msg-e")
+            got = {tag for tag in "dwe" if f"msg-{tag}" in out.getvalue()}
+            assert got == visible, level
+
+
+class _Exploding:
+    """Formatting this object is an error — proves gated calls never
+    run the % formatting."""
+
+    def __str__(self):
+        raise AssertionError("formatted a suppressed log argument")
+
+    __repr__ = __str__
+
+
+class TestNoneLoggerZeroCost:
+    def test_none_never_formats_or_writes(self, capsys):
+        NONE.d("expensive %s", _Exploding())
+        NONE.w("expensive %s", _Exploding())
+        NONE.e("expensive %s", _Exploding())
+        captured = capsys.readouterr()
+        assert captured.out == "" and captured.err == ""
+
+    def test_gated_levels_never_format(self):
+        log, out = make_logger(Logger.ERROR)
+        log.d("never %s", _Exploding())
+        log.w("never %s", _Exploding())
+        assert out.getvalue() == ""
+
+    def test_filtered_message_still_formats_lazily_but_safely(self):
+        # A filter miss happens AFTER formatting (the filter matches
+        # against the formatted message) — this documents that
+        # contract: formatting cost is paid only for enabled levels.
+        log, out = make_logger()
+        log.set_filter(H)
+        log.d("plain miss")
+        assert out.getvalue() == ""

@@ -1,0 +1,318 @@
+"""Host metrics plane: registry semantics, Prometheus text exposition
+(golden format), NodeStats, the gateway's /metrics + /stats.json
+endpoints, and the trace-artifact checker."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from opendht_tpu.utils.metrics import (Counter, Gauge, Histogram,
+                                       MetricsRegistry)
+
+
+class TestRegistry:
+    def test_counter_inc_and_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x_total", "help", ("type",))
+        c.inc(type="a")
+        c.inc(2, type="a")
+        c.inc(type="b")
+        assert c.get(type="a") == 3
+        assert c.get(type="b") == 1
+        assert c.get(type="never") == 0
+
+    def test_counter_rejects_negative(self):
+        c = MetricsRegistry().counter("x_total")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_label_set_enforced(self):
+        c = MetricsRegistry().counter("x_total", "", ("a", "b"))
+        with pytest.raises(ValueError):
+            c.inc(a="1")          # missing label b
+        with pytest.raises(ValueError):
+            c.inc(a="1", b="2", z="3")
+
+    def test_idempotent_getter_shares_series(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", "h", ("t",)).inc(t="a")
+        assert reg.counter("x_total", "h", ("t",)).get(t="a") == 1
+
+    def test_reregister_type_mismatch_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("m", "h")
+        with pytest.raises(ValueError):
+            reg.gauge("m", "h")
+        with pytest.raises(ValueError):
+            reg.counter("m", "h", ("extra",))
+
+    def test_gauge_set_add(self):
+        g = MetricsRegistry().gauge("g")
+        g.set(5)
+        g.add(-2)
+        assert g.get() == 3
+
+    def test_histogram_observe(self):
+        h = MetricsRegistry().histogram("h", buckets=(1, 4, 16))
+        for v in (0.5, 3, 3, 20):
+            h.observe(v)
+        [(key, (counts, total, n))] = h.snapshot()
+        assert counts == [1, 3, 3, 4]     # cumulative + inf
+        assert n == 4 and total == 26.5
+
+    def test_histogram_observe_bulk_matches_pointwise(self):
+        reg = MetricsRegistry()
+        a = reg.histogram("a", buckets=(2, 8))
+        for v in (1, 1, 5, 100):
+            a.observe(v)
+        b = reg.histogram("b", buckets=(2, 8))
+        # per-bound counts: <=2: two, (2,8]: one, overflow: one
+        b.observe_bulk([2, 1, 1], total=107.0)
+        [(_, (ca, _, na))] = a.snapshot()
+        [(_, (cb, _, nb))] = b.snapshot()
+        assert ca == cb and na == nb
+
+
+class TestPrometheusExposition:
+    def test_golden_format(self):
+        """Byte-exact exposition for a small registry — the /metrics
+        contract (text format 0.0.4: HELP/TYPE headers, sorted series,
+        escaped label values, histogram bucket/sum/count triples)."""
+        reg = MetricsRegistry()
+        c = reg.counter("dht_msgs_total", "Wire messages", ("dir",))
+        c.inc(3, dir="in")
+        c.inc(dir="out")
+        reg.gauge("dht_nodes", "Nodes").set(7)
+        h = reg.histogram("dht_hops", "Lookup hops", buckets=(1, 2))
+        h.observe(1)
+        h.observe(3)
+        want = (
+            "# HELP dht_hops Lookup hops\n"
+            "# TYPE dht_hops histogram\n"
+            'dht_hops_bucket{le="1"} 1\n'
+            'dht_hops_bucket{le="2"} 1\n'
+            'dht_hops_bucket{le="+Inf"} 2\n'
+            "dht_hops_sum 4\n"
+            "dht_hops_count 2\n"
+            "# HELP dht_msgs_total Wire messages\n"
+            "# TYPE dht_msgs_total counter\n"
+            'dht_msgs_total{dir="in"} 3\n'
+            'dht_msgs_total{dir="out"} 1\n'
+            "# HELP dht_nodes Nodes\n"
+            "# TYPE dht_nodes gauge\n"
+            "dht_nodes 7\n"
+        )
+        assert reg.render_prometheus() == want
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", "", ("v",)).inc(v='a"b\\c\nd')
+        line = reg.render_prometheus().splitlines()[2]
+        assert line == 'c_total{v="a\\"b\\\\c\\nd"} 1'
+
+    def test_unlabeled_metric_renders_zero_series(self):
+        reg = MetricsRegistry()
+        reg.counter("zero_total", "never incremented")
+        assert "zero_total 0" in reg.render_prometheus()
+
+    def test_to_dict_json_roundtrip(self):
+        reg = MetricsRegistry()
+        reg.counter("c_total", "", ("t",)).inc(t="x")
+        reg.gauge("g").set(2.5)
+        d = json.loads(json.dumps(reg.to_dict()))
+        assert d["c_total"] == [{"t": "x", "value": 1}]
+        assert d["g"] == 2.5
+
+
+class TestNodeStats:
+    def test_bare_dht_node_stats(self):
+        from opendht_tpu.core.dht import Dht
+        from opendht_tpu.core.value import Value
+        from opendht_tpu.utils.infohash import InfoHash
+        from opendht_tpu.utils.sockaddr import AF_INET
+        d = Dht()
+        ns = d.node_stats(AF_INET)
+        assert ns.total_nodes == 0 and ns.storage_values == 0
+        # A locally stored value must show in the storage counters.
+        v = Value(b"payload-bytes")
+        v.id = 42
+        d._storage_store(InfoHash.get("k"), v, d.scheduler.time())
+        ns = d.node_stats(AF_INET)
+        assert ns.storage_keys == 1 and ns.storage_values == 1
+        assert ns.storage_bytes > 0
+        assert set(ns.to_dict()) == {
+            "good_nodes", "dubious_nodes", "cached_nodes",
+            "incoming_nodes", "searches", "storage_keys",
+            "storage_values", "storage_bytes"}
+
+    def test_update_metrics_gauges(self):
+        from opendht_tpu.core.dht import Dht
+        d = Dht()
+        d.update_metrics()
+        txt = d.metrics.render_prometheus()
+        for needle in ('dht_nodes{af="ipv4",state="good"} 0',
+                       "# TYPE dht_storage_bytes gauge",
+                       'dht_searches{af="ipv6"} 0'):
+            assert needle in txt, needle
+
+
+class _StubNodeStats:
+    def __init__(self):
+        self.good_nodes = 3
+        self.dubious_nodes = 1
+        self.cached_nodes = 0
+        self.incoming_nodes = 2
+        self.searches = 1
+        self.storage_keys = 4
+        self.storage_values = 5
+        self.storage_bytes = 640
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in (
+            "good_nodes", "dubious_nodes", "cached_nodes",
+            "incoming_nodes", "searches", "storage_keys",
+            "storage_values", "storage_bytes")}
+
+
+class _StubDht:
+    def __init__(self, metrics):
+        self.metrics = metrics
+        self.refreshed = 0
+
+    def update_metrics(self):
+        self.refreshed += 1
+        self.metrics.gauge("dht_storage_values", "Stored values").set(5)
+
+
+class _StubNode:
+    """Just enough DhtRunner surface for the gateway's observability
+    endpoints — no sockets, no crypto (absent in this container)."""
+
+    def __init__(self):
+        self.metrics = MetricsRegistry()
+        self.metrics.counter(
+            "dht_net_messages_total", "msgs", ("dir", "type")
+        ).inc(7, dir="in", type="ping")
+        self.dht = _StubDht(self.metrics)
+
+    def get_node_id(self):
+        return "ab" * 20
+
+    def get_status(self):
+        return "connected"
+
+    def get_node_stats(self, af):
+        return _StubNodeStats()
+
+    def get_stats(self):
+        return {"ping": 7}, {"reply": 7}
+
+
+@pytest.fixture()
+def gateway():
+    from http.server import ThreadingHTTPServer
+
+    from opendht_tpu.tools.http_gateway import make_handler
+    node = _StubNode()
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(node))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield node, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestGatewayEndpoints:
+    def test_metrics_endpoint_prometheus_text(self, gateway):
+        node, base = gateway
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        # Golden-format spot checks: headers + the request counter and
+        # storage gauge the acceptance criteria name.
+        assert "# TYPE dht_net_messages_total counter" in body
+        assert 'dht_net_messages_total{dir="in",type="ping"} 7' in body
+        assert "dht_storage_values 5" in body
+        assert body.endswith("\n")
+        # Scrape refreshed the derived gauges.
+        assert node.dht.refreshed == 1
+
+    def test_stats_json_endpoint(self, gateway):
+        _, base = gateway
+        with urllib.request.urlopen(f"{base}/stats.json",
+                                    timeout=10) as r:
+            assert r.status == 200
+            obj = json.load(r)
+        assert obj["ipv4"]["good_nodes"] == 3
+        assert obj["messages"]["in"]["ping"] == 7
+        assert obj["node_id"] == "ab" * 20
+
+
+class TestDhtnodeStatsCommands:
+    def test_format_stats_table(self):
+        from opendht_tpu.tools.dhtnode import format_stats
+        text = format_stats(_StubNode())
+        assert "good" in text and "IPv4" in text and "IPv6" in text
+        assert "storage: 5 values, 640 B in 4 keys" in text
+        assert "ping 7/0" in text and "reply 0/7" in text
+
+
+class TestCheckTrace:
+    def _artifact(self):
+        return {
+            "kind": "swarm_lookup_trace",
+            "bench": {"n_lookups": 4, "done_frac": 1.0,
+                      "recall_at_8": 1.0},
+            "trace": {
+                "rounds": 2, "max_steps": 48, "n_lookups": 4,
+                "counters": {
+                    "requests": [16, 8], "replies": [64, 32],
+                    "drops": [2, 0], "poison": [0, 0],
+                    "strikes": [0, 0], "convictions": [0, 0],
+                    "churn": [30, 5], "done": [1, 4]},
+                "done_frac": [0.25, 1.0]},
+            "hop_histogram": [0, 1, 3],
+        }
+
+    def test_valid_artifact_passes(self):
+        from opendht_tpu.tools.check_trace import check_trace_obj
+        assert check_trace_obj(self._artifact()) == []
+
+    def test_violations_flagged(self):
+        from opendht_tpu.tools.check_trace import check_trace_obj
+        bad = self._artifact()
+        bad["trace"]["counters"]["done"] = [4, 1]      # not monotone
+        assert any("monotone" in e for e in check_trace_obj(bad))
+        bad = self._artifact()
+        bad["hop_histogram"] = [0, 1]                  # loses lookups
+        assert any("histogram" in e for e in check_trace_obj(bad))
+        bad = self._artifact()
+        bad["trace"]["counters"]["drops"] = [99, 0]    # drops > requests
+        assert any("drops" in e for e in check_trace_obj(bad))
+        bad = self._artifact()
+        bad["bench"]["done_frac"] = 0.5                # trace disagrees
+        assert any("done_frac" in e for e in check_trace_obj(bad))
+
+    def test_chaos_artifact_headline_fallback(self):
+        """chaos-lookup artifacts nest done_frac/recall under
+        bench['headline'] — the cross-checks must still bind there."""
+        from opendht_tpu.tools.check_trace import check_trace_obj
+        art = self._artifact()
+        bench = art["bench"]
+        art["bench"] = {"n_lookups": 4,
+                        "headline": {"done_frac": bench["done_frac"],
+                                     "recall_at_8": bench["recall_at_8"]}}
+        assert check_trace_obj(art) == []
+        art["bench"]["headline"]["done_frac"] = 0.5
+        assert any("done_frac" in e for e in check_trace_obj(art))
+
+    def test_main_on_file(self, tmp_path, capsys):
+        from opendht_tpu.tools.check_trace import main
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps(self._artifact()))
+        assert main([str(p)]) == 0
+        p.write_text("{not json")
+        assert main([str(p)]) == 1

@@ -310,6 +310,66 @@ def test_stats_counters():
     assert o1.get("ping") == 1
     assert i2.get("ping") == 1
     assert i1.get("reply") == 1
+    # Canonical taxonomy: the answered request counts the reply on the
+    # RESPONDER's outbound side too — in/out finally share one key set.
+    assert o2.get("reply") == 1
+    # A reply arrives exactly once in exactly one key — never under
+    # both a raw wire string and the "reply" key.
+    assert sum(v for k, v in i1.items()) == 1
+
+
+def test_stats_canonical_key_set():
+    """Counter keys are a CLOSED set: an unknown inbound method folds
+    into "other" instead of minting an attacker-chosen key, and every
+    key ever emitted is canonical."""
+    from opendht_tpu.net.network_engine import CANONICAL_TYPES
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    evil = msgpack.packb({
+        "a": {"id": bytes(e2.myid)},
+        "q": "totally_made_up_method_xyz",
+        "t": b"zz\x01\x00", "y": "q", "v": "RNG1"})
+    e1.process_message(evil, SockAddr("10.0.0.2", 4222))
+    i1, _ = e1.get_stats()
+    assert i1.get("other") == 1
+    assert "totally_made_up_method_xyz" not in i1
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    e1.send_ping(peer)
+    e1.send_find_node(peer, InfoHash.get("t"))
+    run(clk, sch, 0.2)
+    for eng in (e1, e2):
+        sin, sout = eng.get_stats()
+        assert set(sin) <= set(CANONICAL_TYPES), sin
+        assert set(sout) <= set(CANONICAL_TYPES), sout
+
+
+def test_stats_exposed_through_registry():
+    """The dict views and the Prometheus exposition read ONE source of
+    truth (the registry counter)."""
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    peer = e1.cache.get_node(e2.myid, SockAddr("10.0.0.2", 4222))
+    e1.send_ping(peer)
+    run(clk, sch, 0.1)
+    txt = e1.metrics.render_prometheus()
+    assert 'dht_net_messages_total{dir="out",type="ping"} 1' in txt
+    assert 'dht_net_messages_total{dir="in",type="reply"} 1' in txt
+    assert e1.metrics.get("dht_net_messages_total").get(
+        dir="out", type="ping") == e1.stats_out["ping"]
+
+
+def test_dropped_packets_counted_by_reason():
+    clk, sch, net, [(e1, h1), (e2, h2)] = make_pair()
+    drop = e1.metrics.get("dht_net_dropped_total")
+    # martian: port 0 source
+    e1.process_message(b"x", SockAddr("10.0.0.9", 0))
+    assert drop.get(reason="martian") == 1
+    # unparseable garbage
+    e1.process_message(b"\xc1\xc1\xc1", SockAddr("10.0.0.9", 4222))
+    assert drop.get(reason="parse") == 1
+    # blacklisted source
+    bad = e1.cache.get_node(InfoHash.get("bad"), SockAddr("10.0.0.7", 1))
+    e1.blacklist_node(bad)
+    e1.process_message(b"x", bad.addr)
+    assert drop.get(reason="blacklist") == 1
 
 
 def test_rate_limit_ipv6_64_grouping_compressed():

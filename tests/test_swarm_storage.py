@@ -55,8 +55,8 @@ class TestStoreInsert:
         val = jnp.arange(4, dtype=jnp.uint32) + 100
         seq = jnp.zeros(4, jnp.uint32)
         put = jnp.arange(4, dtype=jnp.int32)
-        store, reps = _store_insert(store, SCFG, node, key, val, seq,
-                                    put, jnp.uint32(7))
+        store, reps, tr = _store_insert(store, SCFG, node, key, val,
+                                        seq, put, jnp.uint32(7))
         used = np.asarray(store.used)
         assert used[3].sum() == 2 and used[5].sum() == 1
         assert used.sum() == 3
@@ -80,7 +80,7 @@ class TestStoreInsert:
             return _store_insert(store, SCFG, node, k,
                                  jnp.array([val], jnp.uint32),
                                  jnp.array([seq], jnp.uint32), put,
-                                 jnp.uint32(0))
+                                 jnp.uint32(0))[:2]
 
         store, r1 = ins(store, 10, 5)
         store, r2 = ins(store, 11, 6)   # newer seq: accepted
@@ -98,8 +98,8 @@ class TestStoreInsert:
         val = jnp.array([7, 8, 9], jnp.uint32)
         seq = jnp.array([1, 3, 2], jnp.uint32)
         put = jnp.arange(3, dtype=jnp.int32)
-        store, _ = _store_insert(store, SCFG, node, k, val, seq, put,
-                                 jnp.uint32(0))
+        store, _, _ = _store_insert(store, SCFG, node, k, val, seq,
+                                    put, jnp.uint32(0))
         assert int(store.used[2].sum()) == 1
         slot = int(np.argmax(np.asarray(store.used[2])))
         assert int(store.vals[2, slot]) == 8 and int(store.seqs[2, slot]) == 3
@@ -108,7 +108,7 @@ class TestStoreInsert:
         scfg = StoreConfig(slots=4, listen_slots=2, max_listeners=64)
         store = empty_store(4, scfg)
         for i in range(6):  # 6 distinct keys through a 4-slot ring
-            store, _ = _store_insert(
+            store, _, _ = _store_insert(
                 store, scfg, jnp.array([0], jnp.int32), _rand_keys(10 + i, 1),
                 jnp.array([i], jnp.uint32), jnp.zeros(1, jnp.uint32),
                 jnp.zeros(1, jnp.int32), jnp.uint32(i))
@@ -130,7 +130,7 @@ class TestStoreInsert:
                 store, scfg, jnp.zeros(p, jnp.int32), keys,
                 jnp.asarray(vals, jnp.uint32),
                 jnp.asarray(seqs, jnp.uint32),
-                jnp.arange(p, dtype=jnp.int32), jnp.uint32(0))
+                jnp.arange(p, dtype=jnp.int32), jnp.uint32(0))[:2]
 
         store, _ = ins(store, jnp.concatenate([ka, kb]), [1, 2], [0, 0])
         assert int(store.used[0].sum()) == 2  # full, cursor=2
@@ -164,7 +164,7 @@ class TestStoreInsert:
         scfg = StoreConfig(slots=4, listen_slots=2, max_listeners=64)
         store = empty_store(4, scfg)
         p = 7  # 7 distinct keys to one node in ONE batch, cap 4
-        store, reps = _store_insert(
+        store, reps, _ = _store_insert(
             store, scfg, jnp.zeros(p, jnp.int32), _rand_keys(20, p),
             jnp.arange(p, dtype=jnp.uint32), jnp.zeros(p, jnp.uint32),
             jnp.arange(p, dtype=jnp.int32), jnp.uint32(0))
@@ -870,14 +870,14 @@ def test_byte_budget_in_batch_refresh_growth(small_swarm):
     # Hand-build requests targeting one node directly via _store_insert.
     node = jnp.zeros((2,), jnp.int32)
     keys = _rand_keys(70, 2)
-    store, acc = _store_insert(
+    store, acc, _ = _store_insert(
         store, scfg, node, keys, jnp.asarray([1, 2], jnp.uint32),
         jnp.ones((2,), jnp.uint32), jnp.arange(2, dtype=jnp.int32),
         jnp.uint32(0), jnp.ones((2,), jnp.uint32),
         jnp.zeros((2,), jnp.uint32))
     assert int(_np.asarray(acc).sum()) == 2          # base = 2
     # grow both to 9 with seq+1: each alone passes (2-1+9=10), together 18
-    store, acc2 = _store_insert(
+    store, acc2, _ = _store_insert(
         store, scfg, node, keys, jnp.asarray([3, 4], jnp.uint32),
         jnp.full((2,), 2, jnp.uint32), jnp.arange(2, dtype=jnp.int32),
         jnp.uint32(1), jnp.full((2,), 9, jnp.uint32),
@@ -898,7 +898,7 @@ def test_byte_budget_huge_size_cannot_wrap(small_swarm):
     import numpy as _np
     node = jnp.zeros((1,), jnp.int32)
     keys = _rand_keys(80, 1)
-    store, acc = _store_insert(
+    store, acc, _ = _store_insert(
         store, scfg, node, keys, jnp.ones((1,), jnp.uint32),
         jnp.ones((1,), jnp.uint32), jnp.zeros((1,), jnp.int32),
         jnp.uint32(0), jnp.asarray([0x80000000], jnp.uint32),

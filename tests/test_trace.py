@@ -1,0 +1,313 @@
+"""Flight recorder: trace-shape invariants, capture-is-a-pure-observer,
+hop histograms, storage sweep counters, and mesh reduction."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.models.swarm import (
+    LookupFaults,
+    LookupTrace,
+    SwarmConfig,
+    build_swarm,
+    chaos_lookup,
+    churn,
+    corrupt_swarm,
+    empty_lookup_trace,
+    hop_histogram,
+    lookup,
+    merge_traces,
+    trace_to_dict,
+    traced_lookup,
+)
+
+CFG = SwarmConfig.for_nodes(2048)
+
+
+@pytest.fixture(scope="module")
+def swarm():
+    return build_swarm(jax.random.PRNGKey(7), CFG)
+
+
+@pytest.fixture(scope="module")
+def traced(swarm):
+    targets = jax.random.bits(jax.random.PRNGKey(1), (64, 5), jnp.uint32)
+    res, trace = traced_lookup(swarm, CFG, targets, jax.random.PRNGKey(2))
+    return targets, res, trace
+
+
+class TestLookupTrace:
+    def test_capture_is_pure_observer(self, swarm, traced):
+        """Same seeds traced vs untraced must give IDENTICAL results —
+        the recorder observes, never perturbs."""
+        targets, res, _ = traced
+        plain = lookup(swarm, CFG, targets, jax.random.PRNGKey(2))
+        assert np.array_equal(np.asarray(plain.found),
+                              np.asarray(res.found))
+        assert np.array_equal(np.asarray(plain.hops),
+                              np.asarray(res.hops))
+
+    def test_shapes_rounds_by_counters(self, traced):
+        """Every counter is a [max_steps] row; rounds bounds them."""
+        _, _, trace = traced
+        for name in LookupTrace._fields:
+            if name == "rounds":
+                continue
+            assert getattr(trace, name).shape == (CFG.max_steps,), name
+        r = int(trace.rounds)
+        assert 1 <= r <= CFG.max_steps
+        # Rounds past the recorded count stayed untouched (all-zero).
+        req = np.asarray(trace.requests)
+        assert (req[r:] == 0).all()
+
+    def test_round_counters_consistent(self, traced):
+        targets, res, trace = traced
+        d = trace_to_dict(trace, targets.shape[0])
+        c = d["counters"]
+        r = d["rounds"]
+        assert all(len(row) == r for row in c.values())
+        # done gauge monotone, ends at the result's done count
+        assert all(b >= a for a, b in zip(c["done"], c["done"][1:]))
+        assert c["done"][-1] == int(np.asarray(res.done).sum())
+        assert d["done_frac"][-1] == 1.0
+        # round 0 solicits alpha per live lookup
+        assert c["requests"][0] == targets.shape[0] * CFG.alpha
+        # clean swarm: nothing drops, nothing is poisoned
+        assert sum(c["drops"]) == 0
+        assert sum(c["poison"]) == 0 and sum(c["strikes"]) == 0
+        # shortlists must actually move while lookups converge
+        assert sum(c["churn"]) > 0
+
+    def test_drops_counted_under_churn(self, swarm):
+        dead = churn(swarm, jax.random.PRNGKey(9), 0.3, CFG)
+        targets = jax.random.bits(jax.random.PRNGKey(11), (48, 5),
+                                  jnp.uint32)
+        _, trace = traced_lookup(dead, CFG, targets,
+                                 jax.random.PRNGKey(12))
+        d = trace_to_dict(trace)
+        # ~30% dead nodes → solicitations to corpses must register
+        assert sum(d["counters"]["drops"]) > 0
+        # drops can never exceed requests in any round
+        for r, (dr, rq) in enumerate(zip(d["counters"]["drops"],
+                                         d["counters"]["requests"])):
+            assert dr <= rq, r
+
+    def test_chaos_trace_records_defense(self, swarm):
+        bz = corrupt_swarm(swarm, jax.random.PRNGKey(3), 0.1, CFG)
+        targets = jax.random.bits(jax.random.PRNGKey(1), (64, 5),
+                                  jnp.uint32)
+        faults = LookupFaults(drop_frac=0.1)
+        res, strikes, trace = chaos_lookup(bz, CFG, targets,
+                                           jax.random.PRNGKey(4),
+                                           faults, collect_trace=True)
+        # Traced and untraced chaos runs agree bit-for-bit.
+        res2, strikes2 = chaos_lookup(bz, CFG, targets,
+                                      jax.random.PRNGKey(4), faults)
+        assert np.array_equal(np.asarray(res.found),
+                              np.asarray(res2.found))
+        assert np.array_equal(np.asarray(strikes), np.asarray(strikes2))
+        d = trace_to_dict(trace)["counters"]
+        assert sum(d["poison"]) > 0, "poisoned claims went unrecorded"
+        assert sum(d["strikes"]) > 0
+        # The conviction gauge's final row equals the strike state.
+        r = trace_to_dict(trace)["rounds"]
+        assert d["convictions"][r - 1] == int(
+            (np.asarray(strikes) >= faults.strike_limit).sum())
+
+    def test_undefended_trace_skips_defense_counters(self, swarm):
+        bz = corrupt_swarm(swarm, jax.random.PRNGKey(3), 0.1, CFG)
+        targets = jax.random.bits(jax.random.PRNGKey(1), (32, 5),
+                                  jnp.uint32)
+        _, _, trace = chaos_lookup(bz, CFG, targets,
+                                   jax.random.PRNGKey(4),
+                                   LookupFaults(defend=False),
+                                   collect_trace=True)
+        d = trace_to_dict(trace)["counters"]
+        assert sum(d["poison"]) == 0 and sum(d["strikes"]) == 0
+        assert sum(d["convictions"]) == 0
+
+    def test_merge_traces(self, traced):
+        _, _, trace = traced
+        m = merge_traces([trace, trace, trace])
+        assert int(m.requests[0]) == 3 * int(trace.requests[0])
+        assert int(m.rounds) == int(trace.rounds)
+
+    def test_merge_traces_unequal_rounds_keeps_gauges_monotone(self):
+        """A chunk that converged early still holds its lookups done
+        while a slower sibling finishes: the done gauge must be
+        forward-filled past each chunk's exit, never dip or undercount
+        (the multi-chunk --trace-out artifact would otherwise fail its
+        own check_trace leg)."""
+        t1 = empty_lookup_trace(CFG)._replace(
+            done=jnp.zeros((CFG.max_steps,), jnp.int32
+                           ).at[0].set(1).at[1].set(4),
+            convictions=jnp.zeros((CFG.max_steps,), jnp.int32
+                                  ).at[1].set(2),
+            rounds=jnp.int32(2))
+        t2 = empty_lookup_trace(CFG)._replace(
+            done=jnp.zeros((CFG.max_steps,), jnp.int32
+                           ).at[0].set(0).at[1].set(2).at[2].set(3),
+            rounds=jnp.int32(3))
+        m = merge_traces([t1, t2])
+        assert int(m.rounds) == 3
+        done = np.asarray(m.done)[:3].tolist()
+        assert done == [1, 6, 7]          # t1's 4 carried into round 2
+        assert (np.diff(done) >= 0).all()
+        # The conviction gauge carries forward the same way.
+        assert int(m.convictions[2]) == 2
+
+    def test_empty_trace_zeroed(self):
+        t = empty_lookup_trace(CFG)
+        assert int(t.rounds) == 0
+        assert int(jnp.sum(t.requests) + jnp.sum(t.done)) == 0
+
+
+class TestHopHistogram:
+    def test_sums_to_lookup_count_and_matches_bincount(self, traced):
+        targets, res, _ = traced
+        hist = np.asarray(hop_histogram(res.hops, CFG.max_steps))
+        assert hist.shape == (CFG.max_steps + 1,)
+        assert hist.sum() == targets.shape[0]
+        want = np.bincount(np.asarray(res.hops),
+                           minlength=CFG.max_steps + 1)
+        assert np.array_equal(hist, want[:CFG.max_steps + 1])
+
+    def test_overflow_clips_to_last_bin(self):
+        hops = jnp.asarray([0, 5, 99, 1000], jnp.int32)
+        hist = np.asarray(hop_histogram(hops, 8))
+        assert hist[0] == 1 and hist[5] == 1 and hist[8] == 2
+        assert hist.sum() == 4
+
+
+class TestShardedTrace:
+    """Mesh reduction: per-shard partial sums psum to one global trace
+    (the multichip dryrun asserts the same on the driver's mesh)."""
+
+    @pytest.fixture(scope="class")
+    def mesh8(self):
+        from opendht_tpu.parallel import make_mesh
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        return make_mesh(8)
+
+    def test_traced_sharded_matches_untraced(self, mesh8):
+        from opendht_tpu.parallel.sharded import (
+            sharded_lookup, traced_sharded_lookup,
+        )
+        cfg = SwarmConfig.for_nodes(8192)
+        sw = build_swarm(jax.random.PRNGKey(0), cfg)
+        targets = jax.random.bits(jax.random.PRNGKey(1), (512, 5),
+                                  jnp.uint32)
+        r0 = sharded_lookup(sw, cfg, targets, jax.random.PRNGKey(2),
+                            mesh8, 2.0)
+        r1, trace = traced_sharded_lookup(sw, cfg, targets,
+                                          jax.random.PRNGKey(2),
+                                          mesh8, 2.0)
+        assert np.array_equal(np.asarray(r0.found), np.asarray(r1.found))
+        d = trace_to_dict(trace, 512)
+        # psum-reduced counters are GLOBAL: round 0 solicits alpha per
+        # lookup across the whole batch, and the final done gauge sees
+        # every lookup on every shard.
+        assert d["counters"]["requests"][0] == 512 * cfg.alpha
+        assert d["counters"]["done"][-1] == int(
+            np.asarray(r1.done).sum())
+
+    def test_chaos_sharded_trace(self, mesh8):
+        from opendht_tpu.parallel.sharded import chaos_sharded_lookup
+        cfg = SwarmConfig.for_nodes(8192)
+        sw = build_swarm(jax.random.PRNGKey(0), cfg)
+        bz = corrupt_swarm(sw, jax.random.PRNGKey(5), 0.05, cfg)
+        targets = jax.random.bits(jax.random.PRNGKey(1), (512, 5),
+                                  jnp.uint32)
+        faults = LookupFaults(drop_frac=0.1)
+        res, strikes, trace = chaos_sharded_lookup(
+            bz, cfg, targets, jax.random.PRNGKey(3), mesh8, faults,
+            2.0, collect_trace=True)
+        d = trace_to_dict(trace)
+        r = d["rounds"]
+        assert sum(d["counters"]["poison"]) > 0
+        # Conviction gauge is REPLICATED state reduced with pmax — it
+        # must equal the strike vector's conviction count, not a
+        # mesh-size multiple of it.
+        assert d["counters"]["convictions"][r - 1] == int(
+            (np.asarray(strikes) >= faults.strike_limit).sum())
+
+
+class TestStoreTrace:
+    def test_announce_trace_accounts_for_replicas(self, swarm):
+        from opendht_tpu.models.storage import (
+            StoreConfig, announce, empty_store, store_stats,
+        )
+        scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024)
+        store = empty_store(CFG.n_nodes, scfg)
+        p = 128
+        keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 1
+        seqs = jnp.ones(p, jnp.uint32)
+        store, rep = announce(swarm, CFG, store, scfg, keys, vals, seqs,
+                              0, jax.random.PRNGKey(2))
+        t = rep.trace.to_dict()
+        total = int(np.asarray(rep.replicas).sum())
+        assert t["accepts_new"] + t["accepts_update"] == total
+        assert t["requests"] >= total
+        assert t["rejects"] >= 0
+        # Re-announcing the same batch at the same seq refreshes in
+        # place: all update accepts, no new keys.
+        store, rep2 = announce(swarm, CFG, store, scfg, keys, vals, seqs,
+                               1, jax.random.PRNGKey(2))
+        t2 = rep2.trace.to_dict()
+        assert t2["accepts_new"] == 0
+        assert t2["accepts_update"] > 0
+        # Stale seq: everything surviving dedup is rejected.
+        store, rep3 = announce(swarm, CFG, store, scfg, keys, vals + 9,
+                               jnp.zeros(p, jnp.uint32), 2,
+                               jax.random.PRNGKey(2))
+        t3 = rep3.trace.to_dict()
+        assert t3["accepts_new"] == 0 and t3["accepts_update"] == 0
+        assert t3["rejects"] > 0
+        assert int(np.asarray(rep3.replicas).sum()) == 0
+        # Gauges agree with the store contents.
+        st = store_stats(store).to_dict()
+        assert st["values"] == int(np.asarray(store.used).sum())
+
+    def test_listener_notify_counted(self, swarm):
+        from opendht_tpu.models.storage import (
+            StoreConfig, announce, empty_store, listen_at,
+        )
+        scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024)
+        store = empty_store(CFG.n_nodes, scfg)
+        keys = jax.random.bits(jax.random.PRNGKey(1), (8, 5), jnp.uint32)
+        regs = jnp.arange(8, dtype=jnp.int32)
+        store, _ = listen_at(swarm, CFG, store, scfg, keys, regs,
+                             jax.random.PRNGKey(3))
+        store, rep = announce(swarm, CFG, store, scfg, keys,
+                              jnp.ones(8, jnp.uint32),
+                              jnp.ones(8, jnp.uint32), 0,
+                              jax.random.PRNGKey(4))
+        assert rep.trace.to_dict()["notified"] > 0
+
+    def test_sharded_trace_is_global(self):
+        from opendht_tpu.models.storage import StoreConfig
+        from opendht_tpu.parallel import make_mesh
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_announce, sharded_empty_store,
+        )
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        mesh = make_mesh(8)
+        cfg = SwarmConfig.for_nodes(8192)
+        sw = build_swarm(jax.random.PRNGKey(0), cfg)
+        scfg = StoreConfig(slots=8, listen_slots=4, max_listeners=1024)
+        store = sharded_empty_store(cfg.n_nodes, scfg, mesh)
+        p = 128
+        keys = jax.random.bits(jax.random.PRNGKey(1), (p, 5), jnp.uint32)
+        store, rep = sharded_announce(
+            sw, cfg, store, scfg, keys, jnp.arange(p, dtype=jnp.uint32)
+            + 1, jnp.ones(p, jnp.uint32), 0, jax.random.PRNGKey(2),
+            mesh, capacity_factor=4.0)
+        t = rep.trace.to_dict()
+        # psum'd accepts equal the mesh-global replica count.
+        assert t["accepts_new"] + t["accepts_update"] == int(
+            np.asarray(rep.replicas).sum())
