@@ -30,11 +30,20 @@ bench:
 # The 100k leg runs with the flight recorder ON (--trace-out) and the
 # artifact is then validated: parses, round counters monotone, and
 # consistent with the reported done_frac/recall — a bench whose trace
-# cannot explain its own numbers must not gate green.
+# cannot explain its own numbers must not gate green.  The same
+# artifact then gates PERF: check_bench fails if lookups/s drops >5%
+# below the recorded r05 row (BENCH_GATE_r05.json, same-platform rate
+# comparison; recall_at_8/done_frac/median_hops gate on any platform).
+# The compaction-equivalence leg (tests/test_compaction.py, riding the
+# `test` prerequisite so it runs exactly once) re-proves the
+# straggler-harvesting ladder is bit-identical to the uncompacted
+# engines (plain, traced, chaos, sharded) before any number from it is
+# trusted; the dryrun asserts the same on the mesh.
 gate: test
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256 --trace-out /tmp/trace.json
 	python -m opendht_tpu.tools.check_trace /tmp/trace.json
+	python -m opendht_tpu.tools.check_bench /tmp/trace.json BENCH_GATE_r05.json
 	python bench.py --mode chaos --nodes 16384 --puts 2048
 	python bench.py --mode chaos-lookup --nodes 16384 --lookups 4096 --recall-sample 256
 

@@ -84,7 +84,19 @@ def main():
                     help="split lookups into device batches of this "
                          "size (0 = single batch); lets big-N swarms "
                          "use augmented tables within HBM")
-    ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--repeat", type=int, default=3,
+                    help="timed steady-state runs (R); the warm-up "
+                         "run that triggers compilation is always "
+                         "excluded, and the BENCH row reports "
+                         "p50/p95 wall across the R runs next to the "
+                         "best-of (wall_s), so compile time cannot "
+                         "leak into any reported number")
+    ap.add_argument("--compact", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="straggler-harvesting lookup compaction "
+                         "(auto = on; off = full-width dispatch every "
+                         "round, the pre-ladder engine — for A/B "
+                         "attribution)")
     ap.add_argument("--recall-sample", type=int, default=512)
     ap.add_argument("--mode",
                     choices=("lookups", "putget", "churn", "crawl",
@@ -216,17 +228,23 @@ def main():
     # runs themselves run traced — the reported rate includes capture
     # cost, keeping the <=5% overhead budget honest.
     use_trace = bool(args.trace_out)
+    compact = args.compact != "off"
     traces = []
+    chunk_stats = []
 
     def run_all(seed):
+        chunk_stats[:] = [dict() for _ in chunks] if compact else []
+        sd = lambda i: chunk_stats[i] if compact else None
         if use_trace:
             pairs = [traced_lookup(swarm, cfg, c,
-                                   jax.random.PRNGKey(seed + i))
+                                   jax.random.PRNGKey(seed + i),
+                                   compact=compact, stats=sd(i))
                      for i, c in enumerate(chunks)]
             rs = [p[0] for p in pairs]
             traces[:] = [p[1] for p in pairs]
         else:
-            rs = [lookup(swarm, cfg, c, jax.random.PRNGKey(seed + i))
+            rs = [lookup(swarm, cfg, c, jax.random.PRNGKey(seed + i),
+                         compact=compact, stats=sd(i))
                   for i, c in enumerate(chunks)]
         for r in rs:
             sync(r)
@@ -278,11 +296,28 @@ def main():
         "n_nodes": args.nodes,
         "n_lookups": args.lookups,
         "wall_s": round(dt, 4),
+        # Steady-state spread over the --repeat runs (warm-up always
+        # excluded): p95 ≈ p50 means no compile/GC straggler polluted
+        # the sample the best-of came from.
+        "wall_p50": round(float(np.percentile(times, 50)), 4),
+        "wall_p95": round(float(np.percentile(times, 95)), 4),
         "median_hops": float(np.median(hops)),
         "done_frac": float(np.asarray(res.done).mean()),
         "recall_at_8": round(recall, 4) if recall is not None else None,
+        "compact": compact,
         "platform": jax.devices()[0].platform,
     }
+    if chunk_stats:
+        # Dispatch attribution for the compaction ladder: how many
+        # rounds actually ran and what fraction of the batch width they
+        # were dispatched at — the denominator of the straggler win.
+        rd = sum(s.get("rounds_dispatched", 0) for s in chunk_stats)
+        rr = sum(s.get("dispatched_row_rounds", 0) for s in chunk_stats)
+        full_rr = sum(s.get("rounds_dispatched", 0) * c.shape[0]
+                      for s, c in zip(chunk_stats, chunks))
+        out["rounds_dispatched"] = rd
+        out["mean_active_frac"] = (round(rr / full_rr, 4)
+                                   if full_rr else None)
     if recall_error is not None:
         out["recall_error"] = recall_error
     if use_trace:
@@ -741,14 +776,20 @@ def sharded_main(args):
             return LookupResultConcat(rs)
         return run
 
+    # --compact steers both engines: the local reference and (via the
+    # burst formulation's ladder) the routed one.  "auto" keeps each
+    # engine's own dispatcher default.
+    kw_l = {} if args.compact == "auto" else {
+        "compact": args.compact == "on"}
     sync_l = lambda r: int(np.asarray(jnp.sum(r.found[:, 0])))
     t_local = timed(chunked(
-        lambda c, s: lookup(swarm, cfg, c, jax.random.PRNGKey(s))),
-        sync_l)
+        lambda c, s: lookup(swarm, cfg, c, jax.random.PRNGKey(s),
+                            **kw_l)), sync_l)
     t_shard = timed(chunked(
         lambda c, s: sharded_lookup(swarm, cfg, c,
                                     jax.random.PRNGKey(s), mesh,
-                                    capacity_factor=2.0)), sync_l)
+                                    capacity_factor=2.0, **kw_l)),
+        sync_l)
     ladder = {}
     if args.decompose and n_dev == 1:
         # Overhead ladder on the 1-device mesh: each rung adds one

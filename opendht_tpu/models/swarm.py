@@ -292,6 +292,12 @@ class LookupTrace(NamedTuple):
     * ``convictions`` — blacklisted nodes at round end (gauge);
     * ``churn``     — shortlist slots whose occupant changed;
     * ``done``      — lookups done at round end (gauge, monotone);
+    * ``active_rows`` — lookups still pending at round ENTRY (gauge,
+      monotone non-increasing; the complement of the previous round's
+      ``done``).  The area between this curve and the batch width is
+      the row-rounds a full-width dispatcher wastes on finished
+      lookups — the number the compaction ladder exists to reclaim
+      (``trace_to_dict`` derives it as ``wasted_row_rounds``);
     * ``rounds``    — scalar: rounds actually executed.
     """
     requests: jax.Array     # [R] int32
@@ -302,6 +308,7 @@ class LookupTrace(NamedTuple):
     convictions: jax.Array  # [R] int32 (gauge)
     churn: jax.Array        # [R] int32
     done: jax.Array         # [R] int32 (gauge)
+    active_rows: jax.Array  # [R] int32 (gauge)
     rounds: jax.Array       # []  int32
 
 
@@ -309,7 +316,7 @@ def empty_lookup_trace(cfg: SwarmConfig) -> LookupTrace:
     z = jnp.zeros((cfg.max_steps,), jnp.int32)
     return LookupTrace(requests=z, replies=z, drops=z, poison=z,
                        strikes=z, convictions=z, churn=z, done=z,
-                       rounds=jnp.int32(0))
+                       active_rows=z, rounds=jnp.int32(0))
 
 
 def merge_traces(traces) -> LookupTrace:
@@ -323,14 +330,20 @@ def merge_traces(traces) -> LookupTrace:
     a 9-round sibling finishes, so without the fill the merged done
     gauge would DIP at round 7 and undercount the final row —
     summing raw gauge rows across different round counts is the bug,
-    not the contract.
+    not the contract.  ``active_rows`` gets the same treatment with
+    its post-exit value, which is ZERO — a converged chunk has nothing
+    pending while its siblings finish — so the merged gauge stays
+    monotone non-increasing and ``active[r] == L - done[r-1]`` keeps
+    holding across chunks (the ``check_trace`` invariants).
     """
     def fill_forward(t: LookupTrace) -> LookupTrace:
         r = jnp.maximum(t.rounds, 1)
         idx = jnp.arange(t.done.shape[0])
         ff = lambda row: jnp.where(idx < r, row, row[r - 1])
         return t._replace(done=ff(t.done),
-                          convictions=ff(t.convictions))
+                          convictions=ff(t.convictions),
+                          active_rows=jnp.where(idx < r, t.active_rows,
+                                                0))
 
     out = fill_forward(traces[0])
     for t in traces[1:]:
@@ -360,6 +373,12 @@ def trace_to_dict(trace: LookupTrace,
         out["n_lookups"] = int(n_lookups)
         out["done_frac"] = [round(int(d) / n_lookups, 6)
                             for d in host.done[:r]]
+        # Row-rounds a full-width dispatcher spends on already-finished
+        # lookups: the area between the batch width and the active
+        # curve — the quantity the compaction shape ladder reclaims
+        # (README "Performance").
+        out["wasted_row_rounds"] = int(sum(
+            max(0, n_lookups - int(a)) for a in host.active_rows[:r]))
     return out
 
 
@@ -844,7 +863,7 @@ def init_impl(ids: jax.Array, respond, cfg: SwarmConfig,
 def step_impl(ids: jax.Array, alive: jax.Array, respond,
               cfg: SwarmConfig, st: LookupState,
               trace: LookupTrace | None = None,
-              rnd: jax.Array | None = None):
+              rnd: jax.Array | None = None, done_base: int = 0):
     """Shared lock-step solicitation round (vectorized ``searchStep``,
     src/dht.cpp:1343-1464): select α unqueried, solicit via
     ``respond``, merge responses, re-sort, check sync quorum.
@@ -852,7 +871,11 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     With a ``trace`` (and its round index ``rnd``), returns
     ``(state, trace)`` with the round's counters folded in — the
     flight-recorder path; ``trace=None`` (default) keeps the bare
-    hot-path signature."""
+    hot-path signature.  ``done_base`` is the count of finished rows
+    the compaction ladder excluded from this dispatch (they sit
+    outside ``st`` but are still done) — added to the done GAUGE so a
+    truncated dispatch reports the same batch-wide convergence curve
+    as a full-width one."""
     # Finished lookups stop soliciting: besides wasting gathers, their
     # traffic would consume bounded all_to_all capacity and could
     # starve still-active queries on a hot shard.
@@ -861,14 +884,15 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     sel_alive = (sel >= 0) & alive[jnp.clip(sel, 0, cfg.n_nodes - 1)]
     resp, resp_d0, answered = respond(st.targets, sel, sel_d0)  # [L,A*2K]
     return _merge_round(st, cfg, sel, sel_alive, answered, resp,
-                        resp_d0, trace=trace, rnd=rnd)
+                        resp_d0, trace=trace, rnd=rnd,
+                        done_base=done_base)
 
 
 def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
                  sel_alive: jax.Array, answered: jax.Array,
                  resp: jax.Array, resp_d0: jax.Array,
                  trace: LookupTrace | None = None,
-                 rnd: jax.Array | None = None):
+                 rnd: jax.Array | None = None, done_base: int = 0):
     """Round tail shared by the plain and chaos engines: fold the α
     solicitations' outcomes into the shortlist, merge, re-sort, check
     the sync quorum.  ONE copy of the merge/eviction/done semantics,
@@ -923,8 +947,13 @@ def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
             mode="drop"),
         churn=trace.churn.at[rnd].add(
             jnp.sum((f_idx != st.idx).astype(i32)), mode="drop"),
-        done=trace.done.at[rnd].set(jnp.sum(done.astype(i32)),
-                                    mode="drop"),
+        done=trace.done.at[rnd].set(
+            jnp.sum(done.astype(i32)) + i32(done_base), mode="drop"),
+        # Pending at round ENTRY (pre-merge done mask).  Rows hidden by
+        # the compaction ladder are all done, so the prefix's pending
+        # count IS the batch-wide one — no done_base needed here.
+        active_rows=trace.active_rows.at[rnd].add(
+            jnp.sum((~st.done).astype(i32)), mode="drop"),
         rounds=jnp.maximum(trace.rounds, i32(rnd) + 1))
     return new_st, trace
 
@@ -987,8 +1016,22 @@ def lookup_step(swarm: Swarm, cfg: SwarmConfig,
                      cfg, st)
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _lookup_step_d(swarm: Swarm, cfg: SwarmConfig,
+                   st: LookupState) -> LookupState:
+    """:func:`lookup_step` with the state DONATED — the burst-loop
+    carry is single-owner, so XLA reuses its buffers in place instead
+    of holding input+output copies across every round (and across the
+    compaction repack).  Internal to the burst loops: external callers
+    keep the non-donating :func:`lookup_step`, whose inputs stay
+    valid."""
+    return step_impl(swarm.ids, swarm.alive, _local_respond(swarm, cfg),
+                     cfg, st)
+
+
 def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
-           key: jax.Array) -> LookupResult:
+           key: jax.Array, compact: bool = True,
+           stats: dict | None = None) -> LookupResult:
     """Run a batch of iterative lookups to completion.
 
     ``targets``: ``[L,5]``.  Origins are random alive nodes (each
@@ -1003,22 +1046,36 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     scalar readback through the device tunnel costs ~100 ms, so a
     per-round check would serialize the loop on round-trips, while
     burst dispatches pipeline back-to-back on the device.  Finished
-    lookups are frozen inside ``lookup_step``, so overshooting the
+    lookups are frozen inside the step, so overshooting the
     convergence round by a few bursts is wall-clock waste only, never
     a semantics change.
+
+    ``compact`` (default) turns on the straggler-harvesting ladder:
+    after the first burst, pending rows are stably repacked to the
+    front and tail rounds dispatch on shrinking power-of-two prefixes,
+    with finished rows scattered back at finalize — bit-identical
+    results (see the compaction block comment), tail rounds priced by
+    the ACTIVE set instead of the batch width.  (The round-5 one-shot
+    quarter-width variant measured slower at 10M because it paid an
+    extra pending readback and a fixed width; the ladder reuses the
+    existing done-check and tracks the true tail.)  ``stats`` receives
+    the dispatch-attribution fields (see
+    :func:`run_compacted_burst_loop`).
     """
     l = targets.shape[0]
     # Origins are drawn from *alive* nodes: the issuing node exists.
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
-    st = run_burst_loop(lambda s, r: lookup_step(swarm, cfg, s), st,
-                        cfg)
-    # (A tail-compaction variant — argsort the active minority into a
-    # quarter-width sub-batch after the burst — measured SLOWER at 10M:
-    # 334.8k vs 357.6k lookups/s; the sort/gather/scatter and the extra
-    # pending-count readback cost more than 2-3 cheaper tail rounds.)
-    return LookupResult(found=_finalize(swarm.ids, st, cfg),
-                        hops=st.hops, done=st.done)
+    if not compact:
+        st = run_burst_loop(lambda s, r: lookup_step(swarm, cfg, s), st,
+                            cfg)
+        return LookupResult(found=_finalize(swarm.ids, st, cfg),
+                            hops=st.hops, done=st.done)
+    st, _, order = run_compacted_burst_loop(
+        lambda s, ex, r, hidden: (_lookup_step_d(swarm, cfg, s), ex),
+        st, cfg, stats=stats)
+    found, hops, done = _finalize_scattered(swarm.ids, st, order, cfg)
+    return LookupResult(found=found, hops=hops, done=done)
 
 
 def burst_schedule(cfg: SwarmConfig) -> int:
@@ -1066,6 +1123,162 @@ def run_burst_loop(step_fn, state, cfg: SwarmConfig,
     return state
 
 
+# ---------------------------------------------------------------------------
+# straggler harvesting: done-partitioned compaction of the burst loop
+# ---------------------------------------------------------------------------
+#
+# Hop counts concentrate around log2 N / log2 k but carry a long tail
+# (arXiv 1307.7000): the done gauge crosses ~90 % several rounds before
+# the loop exits, yet every full-width round pays [L]-wide gathers,
+# merges and sorts for rows that finished long ago.  After each burst
+# the pending rows are stably repacked to the front and subsequent
+# rounds dispatch on a power-of-two-truncated PREFIX (shape ladder
+# L, …, 2^k, … — at most log2 L step specializations, each compiled
+# once since pending only shrinks).  Stability is what makes the
+# compacted engines bit-identical to the uncompacted ones: every round
+# op is row-local (responds gather per row, the fault hashes key on
+# (node, target, round), strikes scatter into [N]) EXCEPT the sharded
+# transport's capacity bucketing, which ranks real queries by arrival
+# order — done rows emit no queries and a stable repack preserves the
+# pending rows' relative order, so the ranks (and hence capacity
+# drops) are unchanged.  Finished rows wait outside the prefix and are
+# scattered back to their original positions at finalize.  Every jit
+# below DONATES its state operands so the repack never holds two
+# copies of the [L,S] carry (the round-5 attempt's HBM regression).
+
+def _ladder_width(pending: int, l: int, floor: int = 128) -> int:
+    """Dispatch width covering ``pending`` rows: the smallest power of
+    two ≥ pending (and ≥ ``floor`` — sub-lane widths waste more in
+    relaunch overhead than they save), capped at the batch width."""
+    if pending >= l:
+        return l
+    p = max(1, pending, min(floor, l))
+    return min(l, 1 << (p - 1).bit_length())
+
+
+def _stable_done_perm(done: jax.Array) -> jax.Array:
+    """Stable pending-first permutation of row indices.
+
+    ``lax.sort`` with ``is_stable`` rather than ``jnp.argsort`` —
+    stability is a CORRECTNESS requirement here (see the block comment
+    above), not a tiebreak nicety."""
+    l = done.shape[0]
+    _, perm = jax.lax.sort(
+        (done.astype(jnp.int32), jnp.arange(l, dtype=jnp.int32)),
+        dimension=0, num_keys=1, is_stable=True)
+    return perm
+
+
+def _permute_state(st: LookupState, perm: jax.Array) -> LookupState:
+    return LookupState(*[jnp.take(x, perm, axis=0) for x in st])
+
+
+@partial(jax.jit, static_argnames=("w",), donate_argnums=(0, 1))
+def _compact_slice(st: LookupState, order: jax.Array, w: int):
+    """First compaction: repack pending-first, return the repacked
+    full state, the row provenance, and the ``[:w]`` dispatch view."""
+    perm = _stable_done_perm(st.done)
+    full = _permute_state(st, perm)
+    return full, order[perm], LookupState(*[x[:w] for x in full])
+
+
+@partial(jax.jit, static_argnames=("w",), donate_argnums=(0, 1))
+def _compact_resize(full: LookupState, order: jax.Array,
+                    sub: LookupState, w: int):
+    """Subsequent compactions: fold the advanced prefix back into the
+    full state, repack, re-slice at the (smaller) ladder width.  The
+    [w_old] ``sub`` is not donated — its buffers can alias neither the
+    [L] full state nor the narrower new slice."""
+    wo = sub.done.shape[0]
+    full = LookupState(*[f.at[:wo].set(s) for f, s in zip(full, sub)])
+    perm = _stable_done_perm(full.done)
+    full = _permute_state(full, perm)
+    return full, order[perm], LookupState(*[x[:w] for x in full])
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _writeback_prefix(full: LookupState, sub: LookupState) -> LookupState:
+    wo = sub.done.shape[0]
+    return LookupState(*[f.at[:wo].set(s) for f, s in zip(full, sub)])
+
+
+def _scatter_rows(x: jax.Array, order: jax.Array) -> jax.Array:
+    """Return rows to their pre-compaction batch positions (``order[i]``
+    is row ``i``'s original index)."""
+    return jnp.zeros_like(x).at[order].set(x)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _finalize_scattered(ids: jax.Array, st: LookupState,
+                        order: jax.Array, cfg: SwarmConfig):
+    found = _finalize(ids, st, cfg)
+    return (_scatter_rows(found, order), _scatter_rows(st.hops, order),
+            _scatter_rows(st.done, order))
+
+
+def run_compacted_burst_loop(step_fn, st: LookupState, cfg: SwarmConfig,
+                             extras=(), stats: dict | None = None):
+    """:func:`run_burst_loop` with active-set compaction.
+
+    ``step_fn(st, extras, rnd, hidden)`` advances one round and returns
+    ``(st, extras)``; ``hidden`` (a Python int, ≤ log2 L distinct
+    values) is the count of finished rows excluded from the dispatched
+    prefix — traced steps add it to the done gauge.  ``extras`` is an
+    opaque tuple riding the carry at full shape (chaos strike vectors,
+    traces); only the ``LookupState`` is compacted.  The done-check
+    readback the burst loop already pays doubles as the pending count
+    that drives the shape ladder, so compaction adds ZERO extra host
+    syncs.  Returns ``(full_state, extras, order)`` — ``order[i]`` is
+    row ``i``'s original batch position, for the finalize scatter-back.
+
+    ``stats`` (optional dict) receives ``rounds_dispatched``,
+    ``dispatched_row_rounds``, ``mean_active_frac`` and the distinct
+    ``widths`` used — the bench's attribution fields.
+    """
+    l = st.done.shape[0]
+    order = jnp.arange(l, dtype=jnp.int32)
+    full, sub, w = st, st, l
+    # First burst SHORTENED vs the uncompacted loop's calibrated
+    # convergence depth: the done gauge crosses ~90 % two rounds
+    # before the burst exit (measured 100k/1M/10M pending-by-round),
+    # so stopping the full-width burst at the knee and letting the
+    # ladder price the last rounds by the active set is where most of
+    # the wasted row-rounds are — the cost is ONE extra done-check
+    # readback vs aiming the whole depth.
+    burst = max(2, burst_schedule(cfg) - 2)
+    rounds = 0
+    row_rounds = 0
+    widths = []
+    while rounds < cfg.max_steps:
+        n = min(burst, cfg.max_steps - rounds)
+        for _ in range(n):
+            sub, extras = step_fn(sub, extras, rounds, l - w)
+            rounds += 1
+            row_rounds += w
+        if w not in widths:
+            widths.append(w)
+        pending = int(jnp.sum(~sub.done))
+        if pending == 0:
+            break
+        burst = 2
+        w_new = _ladder_width(pending, l)
+        if w_new < w:
+            if w == l:
+                full, order, sub = _compact_slice(sub, order, w_new)
+            else:
+                full, order, sub = _compact_resize(full, order, sub,
+                                                   w_new)
+            w = w_new
+    full = _writeback_prefix(full, sub) if w < l else sub
+    if stats is not None:
+        stats["rounds_dispatched"] = rounds
+        stats["dispatched_row_rounds"] = row_rounds
+        stats["mean_active_frac"] = (
+            round(row_rounds / (rounds * l), 4) if rounds else 0.0)
+        stats["widths"] = widths
+    return full, extras, order
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def traced_lookup_step(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
                        trace: LookupTrace, rnd: jax.Array):
@@ -1073,8 +1286,24 @@ def traced_lookup_step(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
                      cfg, st, trace=trace, rnd=rnd)
 
 
+@partial(jax.jit, static_argnames=("cfg", "done_base"),
+         donate_argnums=(2,))
+def _traced_lookup_step_d(swarm: Swarm, cfg: SwarmConfig,
+                          st: LookupState, trace: LookupTrace,
+                          rnd: jax.Array, done_base: int = 0):
+    """Donated-carry :func:`traced_lookup_step` for the compacted burst
+    loop; ``done_base`` folds the ladder-hidden finished rows into the
+    done gauge (one static value per ladder width).  The trace is NOT
+    donated: it is [max_steps]-tiny, and ``empty_lookup_trace`` aliases
+    one zeros buffer across its fields (double-donation)."""
+    return step_impl(swarm.ids, swarm.alive, _local_respond(swarm, cfg),
+                     cfg, st, trace=trace, rnd=rnd, done_base=done_base)
+
+
 def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
-                  key: jax.Array) -> tuple[LookupResult, LookupTrace]:
+                  key: jax.Array, compact: bool = True,
+                  stats: dict | None = None
+                  ) -> tuple[LookupResult, LookupTrace]:
     """:func:`lookup` with the flight recorder on: identical semantics
     and seeds (same origins, same solicitation schedule — the trace
     scatters are pure observers), returning ``(result, LookupTrace)``.
@@ -1083,17 +1312,32 @@ def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     host syncs — the only readbacks are the burst loop's existing
     done-checks; the trace itself stays on device until the caller
     materializes it (:func:`trace_to_dict`, one ``device_get``).
+    Compaction (default, like :func:`lookup`) leaves the trace
+    untouched too: hidden rows fold into the done gauge via
+    ``done_base``, and a compacted traced run records the same counters
+    as an uncompacted one (asserted in ``tests/test_compaction.py``).
     """
     l = targets.shape[0]
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
     trace = empty_lookup_trace(cfg)
-    st, trace = run_burst_loop(
-        lambda c, r: traced_lookup_step(swarm, cfg, c[0], c[1],
-                                        jnp.int32(r)),
-        (st, trace), cfg, done_of=lambda c: c[0].done)
-    return (LookupResult(found=_finalize(swarm.ids, st, cfg),
-                         hops=st.hops, done=st.done), trace)
+    if not compact:
+        st, trace = run_burst_loop(
+            lambda c, r: traced_lookup_step(swarm, cfg, c[0], c[1],
+                                            jnp.int32(r)),
+            (st, trace), cfg, done_of=lambda c: c[0].done)
+        return (LookupResult(found=_finalize(swarm.ids, st, cfg),
+                             hops=st.hops, done=st.done), trace)
+
+    def step(s, ex, r, hidden):
+        s, tr = _traced_lookup_step_d(swarm, cfg, s, ex[0],
+                                      jnp.int32(r), hidden)
+        return s, (tr,)
+
+    st, (trace,), order = run_compacted_burst_loop(
+        step, st, cfg, extras=(trace,), stats=stats)
+    found, hops, done = _finalize_scattered(swarm.ids, st, order, cfg)
+    return (LookupResult(found=found, hops=hops, done=done), trace)
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_steps"))
@@ -1260,7 +1504,8 @@ def chaos_step_impl(ids: jax.Array, alive: jax.Array,
                     cfg: SwarmConfig, faults: LookupFaults,
                     st: LookupState, strikes: jax.Array,
                     rnd: jax.Array, allreduce=None, byz_aux=None,
-                    trace: LookupTrace | None = None):
+                    trace: LookupTrace | None = None,
+                    done_base: int = 0):
     """One adversarial lock-step round: :func:`step_impl` plus the
     Byzantine fault model and the strike/blacklist defense.
 
@@ -1402,7 +1647,8 @@ def chaos_step_impl(ids: jax.Array, alive: jax.Array,
     # convicted RESPONDERS leave shortlists at the next round's
     # blacklist eviction (plus the final _censor_convicted pass).
     merged = _merge_round(st, cfg, sel, sel_alive, answered, resp,
-                          resp_d0, trace=trace, rnd=rnd)
+                          resp_d0, trace=trace, rnd=rnd,
+                          done_base=done_base)
     if trace is None:
         new_st = merged
     else:
@@ -1477,10 +1723,28 @@ def chaos_lookup_step(swarm: Swarm, cfg: SwarmConfig,
                            trace=trace)
 
 
+@partial(jax.jit, static_argnames=("cfg", "faults", "done_base"),
+         donate_argnums=(3,))
+def _chaos_step_d(swarm: Swarm, cfg: SwarmConfig, faults: LookupFaults,
+                  st: LookupState, strikes: jax.Array, rnd: jax.Array,
+                  byz_aux=None, trace: LookupTrace | None = None,
+                  done_base: int = 0):
+    """Donated-carry :func:`chaos_lookup_step` for the compacted burst
+    loop.  Only the [L,S] state is donated: ``byz_aux`` is
+    run-constant, the trace is [max_steps]-tiny, and the [N] strike
+    vector must SURVIVE its step — the loop keeps the previous round's
+    strikes alive for the deferred blacklist-eviction pass."""
+    return chaos_step_impl(swarm.ids, swarm.alive, swarm.byzantine,
+                           _local_respond(swarm, cfg), cfg, faults,
+                           st, strikes, rnd, byz_aux=byz_aux,
+                           trace=trace, done_base=done_base)
+
+
 def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                  key: jax.Array,
                  faults: LookupFaults = LookupFaults(),
-                 collect_trace: bool = False):
+                 collect_trace: bool = False, compact: bool = True,
+                 stats: dict | None = None):
     """Run a batch of lookups to completion UNDER the adversarial
     fault model (Byzantine responders + exchange loss) with the
     strike/blacklist defense — the lookup-path twin of the storage
@@ -1495,6 +1759,13 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     ``swarm.byzantine``.  ``collect_trace=True`` turns the flight
     recorder on and returns ``(result, strikes, LookupTrace)`` —
     capture rides the loop carry, adding no host syncs.
+
+    Compaction (default, like :func:`lookup`) is bit-identical here
+    too: every fault-model decision keys on (node id, target, round) —
+    never on a row's batch position — and strike state scatters into
+    the [N] axis, so a stable repack changes nothing the adversary or
+    the defense can observe (asserted incl. a churn+byzantine case in
+    ``tests/test_compaction.py``).
     """
     l = targets.shape[0]
     honest_alive = (swarm.alive if swarm.byzantine is None
@@ -1505,12 +1776,44 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     byz_aux = (byz_colluder_pool(swarm.byzantine)
                if faults.eclipse and swarm.byzantine is not None
                else None)
+    trace0 = empty_lookup_trace(cfg) if collect_trace else None
+    if compact:
+        # The strike vector as of the LAST round's start: its blacklist
+        # is what the full-width engine last scrubbed every shortlist
+        # with (last-round convictions reach results only through
+        # _censor_convicted, there as here).
+        prev = {"strikes": strikes}
+
+        def step(s, ex, r, hidden):
+            prev["strikes"] = ex[0]
+            out = _chaos_step_d(swarm, cfg, faults, s, ex[0],
+                                jnp.int32(r), byz_aux,
+                                trace=(ex[1] if collect_trace else None),
+                                done_base=hidden)
+            return out[0], tuple(out[1:])
+
+        extras = (strikes, trace0) if collect_trace else (strikes,)
+        st, extras, order = run_compacted_burst_loop(
+            step, st, cfg, extras=extras, stats=stats)
+        strikes = extras[0]
+        if collect_trace:
+            trace = extras[1]
+        if faults.defend:
+            # Frozen done rows missed the per-round blacklist scrubs —
+            # apply them in one deferred pass (see _evict_blacklisted).
+            st = _evict_blacklisted(
+                st, prev["strikes"] >= faults.strike_limit, cfg)
+        found, hops, done = _finalize_scattered(swarm.ids, st, order,
+                                                cfg)
+        found = _censor_convicted(found, strikes, cfg, faults)
+        res = LookupResult(found=found, hops=hops, done=done)
+        return (res, strikes, trace) if collect_trace else (res, strikes)
     if collect_trace:
         st, strikes, trace = run_burst_loop(
             lambda c, r: chaos_lookup_step(swarm, cfg, faults, c[0],
                                            c[1], jnp.int32(r), byz_aux,
                                            trace=c[2]),
-            (st, strikes, empty_lookup_trace(cfg)), cfg,
+            (st, strikes, trace0), cfg,
             done_of=lambda c: c[0].done)
     else:
         st, strikes = run_burst_loop(
@@ -1521,6 +1824,34 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     found = _censor_convicted(found, strikes, cfg, faults)
     res = LookupResult(found=found, hops=st.hops, done=st.done)
     return (res, strikes, trace) if collect_trace else (res, strikes)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _evict_blacklisted(st: LookupState, blk: jax.Array,
+                       cfg: SwarmConfig) -> LookupState:
+    """One deferred blacklist-eviction + re-sort pass over every row.
+
+    The full-width chaos engine scrubs convicted nodes from EVERY
+    shortlist at each round start — including rows that are already
+    done — and the follow-up merge promotes the next-best survivors
+    into the vacated head slots.  The compaction ladder freezes
+    finished rows outside the dispatch prefix, so they miss those
+    per-round scrubs; this single pass applied before ``_finalize``
+    reproduces them exactly: convictions are permanent (the union of
+    per-round blacklists is the final pre-last-round blacklist) and
+    the merge is order-deterministic on the surviving set, so evicting
+    once with the union and re-sorting once lands bit-identical state.
+    For rows that were dispatched through the last round it is a
+    no-op: their shortlists were scrubbed with this same blacklist at
+    the last round's start, and incoming candidates are blk-rejected.
+    """
+    n = cfg.n_nodes
+    conv = (st.idx >= 0) & blk[jnp.clip(st.idx, 0, n - 1)]
+    idx = jnp.where(conv, -1, st.idx)
+    dist = jnp.where(conv, jnp.uint32(UINT32_MAX), st.dist)
+    f_idx, f_dist, f_q = merge_shortlists_d0(
+        dist, idx, st.queried & ~conv, keep=cfg.search_width)
+    return st._replace(idx=f_idx, dist=f_dist, queried=f_q)
 
 
 def _censor_convicted(found: jax.Array, strikes: jax.Array,

@@ -40,13 +40,18 @@ from ..models.swarm import (
     SwarmConfig,
     _finalize,
     _gather_span,
+    _ladder_width,
     _local_respond,
+    _permute_state,
     _respond,
     _sample_origins,
+    _scatter_rows,
     _select_alpha,
     _censor_convicted,
     _select_pair_window,
+    _stable_done_perm,
     _unpack_pair_window,
+    burst_schedule,
     byz_colluder_pool,
     chaos_step_impl,
     device_hbm_bytes,
@@ -64,12 +69,18 @@ from .mesh import AXIS, shard_map
 def data_parallel_lookup(swarm: Swarm, cfg: SwarmConfig,
                          targets: jax.Array, key: jax.Array,
                          mesh: Mesh) -> LookupResult:
-    """Lookup batch sharded over the mesh; node state replicated."""
+    """Lookup batch sharded over the mesh; node state replicated.
+
+    Runs UNCOMPACTED: the local engine's repack is a global row
+    permutation, which GSPMD would lower to cross-device shuffles of
+    the batch-sharded state (and ladder widths need not divide the
+    mesh) — the compacted form of this mode is the table-sharded
+    engine's per-shard ladder (:func:`sharded_lookup`)."""
     rep = NamedSharding(mesh, P())
     shd = NamedSharding(mesh, P(AXIS, None))
     swarm = jax.device_put(swarm, rep)
     targets = jax.device_put(targets, shd)
-    return lookup(swarm, cfg, targets, key)
+    return lookup(swarm, cfg, targets, key, compact=False)
 
 
 # ---------------------------------------------------------------------------
@@ -144,7 +155,7 @@ def _fill_buckets(payload: jax.Array, src: jax.Array, n_shards: int,
 def _route_respond(tables_local: jax.Array, ids: jax.Array,
                    alive: jax.Array, targets: jax.Array, nid: jax.Array,
                    nid_d0: jax.Array, cfg: SwarmConfig, n_shards: int,
-                   capacity_factor: float):
+                   capacity_factor: float, cap_nq: int | None = None):
     """Answer solicitations whose routing tables live on other shards.
 
     ``nid``: ``[Ll, A]`` global node indices (-1 = none); ``nid_d0``
@@ -165,11 +176,19 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
     shard_n = n // n_shards
     ll, a = nid.shape
     q = ll * a
+    # ``cap_nq`` pins the query count the capacity rule is provisioned
+    # for (default: this call's own Ll·A).  The compaction ladder
+    # dispatches rounds on truncated row prefixes, but the transport's
+    # per-shard capacity is a property of the PROVISIONED batch, not of
+    # the dispatch width — shrinking cap with the prefix would both
+    # change drop patterns (breaking the compacted↔uncompacted
+    # seed-identity) and mismodel the hardware.
+    nq = q if cap_nq is None else cap_nq
     if math.isfinite(capacity_factor):
-        cap = min(q, max(a, int(math.ceil(q / n_shards
-                                          * capacity_factor))))
+        cap = min(nq, max(a, int(math.ceil(nq / n_shards
+                                           * capacity_factor))))
     else:
-        cap = q
+        cap = nq
     flat = nid.reshape(-1)
     safe = jnp.clip(flat, 0, n - 1)
     ok = (flat >= 0) & alive[safe]
@@ -258,7 +277,8 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
 
 def _make_responders(cfg: SwarmConfig, n_shards: int,
                      capacity_factor: float, local_respond: bool,
-                     ids, tables_local, alive):
+                     ids, tables_local, alive,
+                     cap_nq: int | None = None):
     """``(respond_init, respond)`` pair shared by the while-loop and
     burst formulations (ONE copy of the respond contract).
 
@@ -276,7 +296,7 @@ def _make_responders(cfg: SwarmConfig, n_shards: int,
         return r, r
     respond = lambda tg, nid, d0: _route_respond(
         tables_local, ids, alive, tg, nid, d0, cfg, n_shards,
-        capacity_factor)
+        capacity_factor, cap_nq=cap_nq)
     respond_init = lambda tg, nid, d0: _route_respond(
         tables_local, ids, alive, tg, nid, d0, cfg, n_shards,
         float("inf"))
@@ -343,9 +363,11 @@ def _sharded_lookup_while(swarm: Swarm, cfg: SwarmConfig,
 
 
 def _make_respond_body(cfg, n_shards, capacity_factor, local_respond,
-                       init):
+                       init, cap_nq=None):
     """Single-round shard_map bodies for the burst path (same respond
-    contract as the while formulation via ``_make_responders``)."""
+    contract as the while formulation via ``_make_responders``).
+    ``cap_nq`` pins capacity provisioning to the full batch width for
+    compaction-truncated dispatches (see ``_route_respond``)."""
     def init_body(ids, tables_local, alive, targets, key):
         ll = targets.shape[0]
         me = jax.lax.axis_index(AXIS)
@@ -359,7 +381,7 @@ def _make_respond_body(cfg, n_shards, capacity_factor, local_respond,
     def step_body(ids, tables_local, alive, st):
         _, respond = _make_responders(
             cfg, n_shards, capacity_factor, local_respond, ids,
-            tables_local, alive)
+            tables_local, alive, cap_nq=cap_nq)
         return step_impl(ids, alive, respond, cfg, st)
 
     return init_body if init else step_body
@@ -386,13 +408,14 @@ def _sharded_lookup_init(swarm, cfg, targets, key, mesh,
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor",
-                                   "local_respond"))
+                                   "local_respond", "cap_nq"),
+         donate_argnums=(2,))
 def _sharded_lookup_step(swarm, cfg, st, mesh, capacity_factor,
-                         local_respond=False):
+                         local_respond=False, cap_nq=None):
     n_shards = mesh.shape[AXIS]
     fn = shard_map(
         _make_respond_body(cfg, n_shards, capacity_factor,
-                           local_respond, init=False),
+                           local_respond, init=False, cap_nq=cap_nq),
         mesh=mesh,
         in_specs=(P(), P(AXIS, None), P(), _st_specs()),
         out_specs=_st_specs(), check_vma=False)
@@ -403,10 +426,172 @@ def _table_bytes_per_device(cfg: SwarmConfig, n_shards: int) -> int:
     return table_bytes(cfg) // max(1, n_shards)
 
 
+# ---------------------------------------------------------------------------
+# straggler harvesting on the routed burst path
+# ---------------------------------------------------------------------------
+#
+# The while formulation spins every shard in the psum'd cond until the
+# SLOWEST shard drains; the burst formulation below instead repacks
+# each shard's pending rows to the front between bursts and dispatches
+# tail rounds on power-of-two-truncated per-shard prefixes (the local
+# engine's shape ladder, shard-local so no rows cross shards and the
+# routed capacity ranks are preserved — see models.swarm's compaction
+# block comment).  The width must cover the WORST shard's pending
+# count; the optional rebalance below fixes that load imbalance with
+# one lossless all_to_all repack: every row gets a global stable rank
+# (pending first) and moves to shard ``rank % D``, position
+# ``rank // D`` — each shard ends with ⌈total/D⌉-balanced pending
+# prefixes, so the whole mesh shrinks together.  Rebalance changes
+# which shard a row queries from, which under a FINITE capacity_factor
+# changes drop patterns — results are seed-identical to the
+# uncompacted engine only at capacity_factor=inf (asserted in tests);
+# plain compaction is seed-identical always.
+
+def _sharded_compact_slice(st, order, mesh, w):
+    def body(st, order):
+        perm = _stable_done_perm(st.done)
+        full = _permute_state(st, perm)
+        return full, order[perm], LookupState(*[x[:w] for x in full])
+
+    fn = shard_map(body, mesh=mesh, in_specs=(_st_specs(), P(AXIS)),
+                   out_specs=(_st_specs(), P(AXIS), _st_specs()),
+                   check_vma=False)
+    return fn(st, order)
+
+
+def _sharded_compact_resize(full, order, sub, mesh, w):
+    def body(full, order, sub):
+        wo = sub.done.shape[0]
+        full = LookupState(*[f.at[:wo].set(s)
+                             for f, s in zip(full, sub)])
+        perm = _stable_done_perm(full.done)
+        full = _permute_state(full, perm)
+        return full, order[perm], LookupState(*[x[:w] for x in full])
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(_st_specs(), P(AXIS), _st_specs()),
+                   out_specs=(_st_specs(), P(AXIS), _st_specs()),
+                   check_vma=False)
+    return fn(full, order, sub)
+
+
+def _sharded_writeback(full, sub, mesh):
+    def body(full, sub):
+        wo = sub.done.shape[0]
+        return LookupState(*[f.at[:wo].set(s)
+                             for f, s in zip(full, sub)])
+
+    fn = shard_map(body, mesh=mesh, in_specs=(_st_specs(), _st_specs()),
+                   out_specs=_st_specs(), check_vma=False)
+    return fn(full, sub)
+
+
+def _pack_rows(st: LookupState, order: jax.Array,
+               pos: jax.Array) -> jax.Array:
+    """Serialize state rows for the rebalance shuffle: ``[Ll, 10+3S]``
+    uint32 — [valid flag | dest position | original row | hops | done |
+    targets 5 | idx S | dist S | queried S]."""
+    b32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.uint32)
+    c = lambda x: x[:, None]
+    return jnp.concatenate(
+        [c(jnp.ones(pos.shape, jnp.uint32)), c(b32(pos)), c(b32(order)),
+         c(b32(st.hops)), c(st.done.astype(jnp.uint32)), st.targets,
+         b32(st.idx), st.dist, st.queried.astype(jnp.uint32)], axis=1)
+
+
+def _unpack_rows(rows: jax.Array, s: int):
+    i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    st = LookupState(
+        targets=rows[:, 5:10], idx=i32(rows[:, 10:10 + s]),
+        dist=rows[:, 10 + s:10 + 2 * s],
+        queried=rows[:, 10 + 2 * s:10 + 3 * s] != 0,
+        done=rows[:, 4] != 0, hops=i32(rows[:, 3]))
+    return st, i32(rows[:, 2])
+
+
+def _rebalance_body(cfg, n_shards, w, st, order):
+    """Per-shard rebalance kernel (inside shard_map): global stable
+    rank → round-robin destination, routed LOSSLESSLY with the
+    ``_bucketize``/``_fill_buckets`` machinery at capacity Ll (a
+    source shard holds at most Ll rows, so no slot can overflow)."""
+    ll = st.done.shape[0]
+    me = jax.lax.axis_index(AXIS)
+    pending = ~st.done
+    pcount = jnp.sum(pending.astype(jnp.int32))
+    counts = jax.lax.all_gather(pcount, AXIS)              # [D]
+    start = jnp.sum(jnp.where(jnp.arange(n_shards) < me, counts, 0))
+    total = jnp.sum(counts)
+    # Global stable rank: pending rows 0..total-1 ordered by (shard,
+    # local position), done rows after — a permutation of 0..L-1.
+    lp = jnp.cumsum(pending.astype(jnp.int32)) - 1
+    ld = jnp.cumsum((~pending).astype(jnp.int32)) - 1
+    g = jnp.where(pending, start + lp,
+                  total + me * ll - start + ld)            # [Ll]
+    dest = (g % n_shards).astype(jnp.int32)
+    pos = (g // n_shards).astype(jnp.int32)
+    pay = _pack_rows(st, order, pos)
+    src, _, _ = _bucketize(dest, jnp.ones((ll,), bool), n_shards, ll)
+    buf = _fill_buckets(pay, src, n_shards, ll, 0)         # [D,Ll,W]
+    a2a = partial(jax.lax.all_to_all, axis_name=AXIS, split_axis=0,
+                  concat_axis=0, tiled=True)
+    back = a2a(buf).reshape(n_shards * ll, -1)             # [D*Ll,W]
+    valid = back[:, 0] == 1
+    rpos = jnp.where(valid, jax.lax.bitcast_convert_type(
+        back[:, 1], jnp.int32), ll)
+    got = jnp.zeros((ll, pay.shape[1]), jnp.uint32
+                    ).at[rpos].set(back, mode="drop")
+    full, order = _unpack_rows(got, cfg.search_width)
+    return full, order, LookupState(*[x[:w] for x in full])
+
+
+def _sharded_rebalance_slice(st, order, cfg, mesh, w):
+    n_shards = mesh.shape[AXIS]
+    fn = shard_map(partial(_rebalance_body, cfg, n_shards, w),
+                   mesh=mesh, in_specs=(_st_specs(), P(AXIS)),
+                   out_specs=(_st_specs(), P(AXIS), _st_specs()),
+                   check_vma=False)
+    return fn(st, order)
+
+
+def _sharded_rebalance_resize(full, order, sub, cfg, mesh, w):
+    n_shards = mesh.shape[AXIS]
+
+    def body(full, order, sub):
+        wo = sub.done.shape[0]
+        full = LookupState(*[f.at[:wo].set(s)
+                             for f, s in zip(full, sub)])
+        return _rebalance_body(cfg, n_shards, w, full, order)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(_st_specs(), P(AXIS), _st_specs()),
+                   out_specs=(_st_specs(), P(AXIS), _st_specs()),
+                   check_vma=False)
+    return fn(full, order, sub)
+
+
+# jit wrappers for the compaction plumbing: static width, donated
+# carries (full/order are single-owner in the burst loop; sub's
+# buffers fit neither output shape, so it is not donated).
+_compact_slice_j = partial(jax.jit, static_argnames=("mesh", "w"),
+                           donate_argnums=(0, 1))
+_sharded_compact_slice = _compact_slice_j(_sharded_compact_slice)
+_sharded_compact_resize = _compact_slice_j(_sharded_compact_resize)
+_sharded_writeback = partial(
+    jax.jit, static_argnames=("mesh",),
+    donate_argnums=(0,))(_sharded_writeback)
+_reb_j = partial(jax.jit, static_argnames=("cfg", "mesh", "w"),
+                 donate_argnums=(0, 1))
+_sharded_rebalance_slice = _reb_j(_sharded_rebalance_slice)
+_sharded_rebalance_resize = _reb_j(_sharded_rebalance_resize)
+
+
 def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                    key: jax.Array, mesh: Mesh,
                    capacity_factor: float = 2.0,
-                   local_respond: bool = False) -> LookupResult:
+                   local_respond: bool = False,
+                   compact: bool | None = None,
+                   rebalance: bool = False,
+                   stats: dict | None = None) -> LookupResult:
     """Full lookup batch with routing tables sharded over ``mesh``.
 
     ``swarm.tables`` is sharded on the node axis; ``ids`` and ``alive``
@@ -417,26 +602,110 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     decomposition aid (see :func:`_sharded_body`).
 
     Dispatches between two equivalent formulations on STATIC config:
-    the collective-synchronised while-loop (faster; carries the table
-    — needs ~2× table HBM) and a host-driven burst loop like the local
-    engine (table passed as a plain input each round, no duplication —
-    how the 10M-node table runs on a 16 GB chip, where the while
-    formulation is a measured OOM).
+    the collective-synchronised while-loop (faster at sizes whose
+    per-device table fits twice in HBM; carries the table) and a
+    host-driven burst loop like the local engine (table passed as a
+    plain input each round, no duplication — how the 10M-node table
+    runs on a 16 GB chip, where the while formulation is a measured
+    OOM).  The burst formulation runs the straggler-harvesting ladder
+    by default: per-shard done-compaction with power-of-two prefix
+    dispatch, seed-identical to the uncompacted engine (capacity stays
+    provisioned at the full batch width — ``cap_nq``).  ``compact``
+    forces the choice: True = always the compacted burst formulation,
+    False = never compact, None = dispatch on table size.
+    ``rebalance`` additionally repacks pending rows ACROSS shards
+    between bursts (lossless all_to_all; see the block comment — only
+    bit-identical at ``capacity_factor=inf``), so the ladder tracks
+    the mean pending load instead of the worst shard's; requesting it
+    forces the compacted burst formulation (and is an error with
+    ``compact=False``).  ``stats`` receives the dispatch-attribution
+    fields like :func:`lookup` plus a ``formulation`` tag; the while
+    formulation has no ladder, so it contributes only the tag.
     """
+    if rebalance and compact is False:
+        raise ValueError("rebalance=True requires the compacted burst "
+                         "formulation (compact must not be False)")
     n_shards = mesh.shape[AXIS]
-    if (2 * _table_bytes_per_device(cfg, n_shards)
-            + LOOKUP_HEADROOM_BYTES <= device_hbm_bytes()):
+    fits_while = (2 * _table_bytes_per_device(cfg, n_shards)
+                  + LOOKUP_HEADROOM_BYTES <= device_hbm_bytes())
+    if compact is not True and not rebalance and fits_while:
+        if stats is not None:
+            stats["formulation"] = "while"
         return _sharded_lookup_while(swarm, cfg, targets, key, mesh,
                                      capacity_factor, local_respond)
     st = _sharded_lookup_init(swarm, cfg, targets, key, mesh,
                               capacity_factor, local_respond)
-    st = run_burst_loop(
-        lambda s, r: _sharded_lookup_step(swarm, cfg, s, mesh,
-                                          capacity_factor,
-                                          local_respond),
-        st, cfg)
-    found = _finalize(swarm.ids, st, cfg)
-    return LookupResult(found=found, hops=st.hops, done=st.done)
+    if compact is False:
+        if stats is not None:
+            stats["formulation"] = "burst"
+        st = run_burst_loop(
+            lambda s, r: _sharded_lookup_step(swarm, cfg, s, mesh,
+                                              capacity_factor,
+                                              local_respond),
+            st, cfg)
+        found = _finalize(swarm.ids, st, cfg)
+        return LookupResult(found=found, hops=st.hops, done=st.done)
+
+    l = targets.shape[0]
+    ll = l // n_shards
+    cap_nq = ll * cfg.alpha       # capacity stays full-width provisioned
+    order = jnp.arange(l, dtype=jnp.int32)
+    full, sub, w = st, st, ll
+    # Shortened first burst, like the local compacted loop: engage the
+    # ladder at the done-curve knee (~2 rounds before the calibrated
+    # exit) for one extra done-check readback.
+    burst = max(2, burst_schedule(cfg) - 2)
+    rounds = row_rounds = 0
+    widths = []
+    while rounds < cfg.max_steps:
+        n = min(burst, cfg.max_steps - rounds)
+        for _ in range(n):
+            sub = _sharded_lookup_step(swarm, cfg, sub, mesh,
+                                       capacity_factor, local_respond,
+                                       cap_nq)
+            rounds += 1
+            row_rounds += w * n_shards
+        if w not in widths:
+            widths.append(w)
+        pend = jax.device_get(
+            jnp.sum(~sub.done.reshape(n_shards, w), axis=1))
+        total = int(pend.sum())
+        if total == 0:
+            break
+        burst = 2
+        if rebalance:
+            w_new = _ladder_width(-(-total // n_shards), ll)
+            if w_new < w:
+                if w == ll:
+                    full, order, sub = _sharded_rebalance_slice(
+                        sub, order, cfg, mesh, w_new)
+                else:
+                    full, order, sub = _sharded_rebalance_resize(
+                        full, order, sub, cfg, mesh, w_new)
+                w = w_new
+        else:
+            w_new = _ladder_width(int(pend.max()), ll)
+            if w_new < w:
+                if w == ll:
+                    full, order, sub = _sharded_compact_slice(
+                        sub, order, mesh, w_new)
+                else:
+                    full, order, sub = _sharded_compact_resize(
+                        full, order, sub, mesh, w_new)
+                w = w_new
+    full = _sharded_writeback(full, sub, mesh) if w < ll else sub
+    if stats is not None:
+        stats["formulation"] = ("burst-rebalanced" if rebalance
+                                else "burst-compacted")
+        stats["rounds_dispatched"] = rounds
+        stats["dispatched_row_rounds"] = row_rounds
+        stats["mean_active_frac"] = (
+            round(row_rounds / (rounds * l), 4) if rounds else 0.0)
+        stats["widths"] = widths
+    found = _scatter_rows(_finalize(swarm.ids, full, cfg), order)
+    return LookupResult(found=found,
+                        hops=_scatter_rows(full.hops, order),
+                        done=_scatter_rows(full.done, order))
 
 
 # ---------------------------------------------------------------------------

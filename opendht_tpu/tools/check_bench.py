@@ -1,0 +1,115 @@
+"""Gate a bench run against a recorded perf register row.
+
+    python -m opendht_tpu.tools.check_bench CURRENT BASELINE \
+        [--min-ratio 0.95]
+
+``CURRENT`` and ``BASELINE`` are JSON files holding either a raw BENCH
+row (the ``{"metric": ..., "value": ...}`` line bench.py prints) or a
+``--trace-out`` flight-recorder artifact (whose ``bench`` field holds
+the row) — the gate reuses the trace artifact it already produced, so
+no extra bench run is paid.
+
+Checks, in decreasing severity:
+
+* ``value`` (lookups/s) must not drop below ``min-ratio`` × the
+  recorded baseline — but ONLY when the two rows ran on the same
+  ``platform``: a CPU container comparing itself against a TPU row (or
+  vice versa) would always fail or always pass meaninglessly, so
+  cross-platform rate comparison is reported as SKIPPED, never as a
+  verdict.  Quality metrics are platform-independent and always gate:
+* ``recall_at_8`` must not regress (> 0.005 absolute drop fails);
+* ``done_frac`` must not regress (> 1e-6 drop fails);
+* ``median_hops`` must not grow by more than 0.5 (a compaction or
+  schedule bug that trades rounds for rate shows up here).
+
+Exit 0 on pass; exit 1 with one line per violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+
+def _load_row(path: str) -> dict:
+    with open(path) as f:
+        obj = json.load(f)
+    if obj.get("kind") == "swarm_lookup_trace":      # trace artifact
+        obj = obj["bench"]
+    if "value" not in obj or "metric" not in obj:
+        raise ValueError(f"{path}: no BENCH row found (need "
+                         f"'metric'/'value' or a trace artifact)")
+    return obj
+
+
+def check_bench_rows(cur: dict, base: dict,
+                     min_ratio: float = 0.95) -> List[str]:
+    """All violations of ``cur`` against ``base`` (empty = pass)."""
+    errs: List[str] = []
+    if cur.get("metric") != base.get("metric"):
+        errs.append(f"metric mismatch: {cur.get('metric')!r} vs "
+                    f"baseline {base.get('metric')!r}")
+        return errs
+
+    if cur.get("platform") == base.get("platform"):
+        floor = min_ratio * base["value"]
+        if cur["value"] < floor:
+            errs.append(
+                f"{cur['metric']} {cur['value']} below {min_ratio:.0%} "
+                f"of recorded baseline {base['value']} "
+                f"(floor {floor:.1f}, platform {cur.get('platform')})")
+    else:
+        print(f"check_bench: rate comparison SKIPPED — platform "
+              f"{cur.get('platform')!r} vs baseline "
+              f"{base.get('platform')!r} (quality gates still apply)")
+
+    r_cur, r_base = cur.get("recall_at_8"), base.get("recall_at_8")
+    if r_cur is not None and r_base is not None \
+            and r_cur < r_base - 0.005:
+        errs.append(f"recall_at_8 regressed: {r_cur} vs baseline "
+                    f"{r_base}")
+    d_cur, d_base = cur.get("done_frac"), base.get("done_frac")
+    if d_cur is not None and d_base is not None \
+            and d_cur < d_base - 1e-6:
+        errs.append(f"done_frac regressed: {d_cur} vs baseline "
+                    f"{d_base}")
+    h_cur, h_base = cur.get("median_hops"), base.get("median_hops")
+    if h_cur is not None and h_base is not None \
+            and h_cur > h_base + 0.5:
+        errs.append(f"median_hops grew: {h_cur} vs baseline {h_base}")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("current")
+    ap.add_argument("baseline")
+    ap.add_argument("--min-ratio", type=float, default=0.95)
+    args = ap.parse_args(argv)
+    try:
+        cur = _load_row(args.current)
+        base = _load_row(args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"check_bench: {e}")
+        return 1
+    errs = check_bench_rows(cur, base, args.min_ratio)
+    if errs:
+        for e in errs:
+            print(f"check_bench: {e}")
+        return 1
+    extra = ""
+    if "mean_active_frac" in cur:
+        extra = (f", mean_active_frac {cur['mean_active_frac']}"
+                 f" over {cur.get('rounds_dispatched')} rounds")
+    print(f"check_bench: OK — {cur['metric']} {cur['value']} "
+          f"{cur.get('unit', '')} vs baseline {base['value']}"
+          f"{extra}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

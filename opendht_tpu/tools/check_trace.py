@@ -18,7 +18,7 @@ import sys
 from typing import List
 
 COUNTERS = ("requests", "replies", "drops", "poison", "strikes",
-            "convictions", "churn", "done")
+            "convictions", "churn", "done", "active_rows")
 
 
 def check_trace_obj(obj: dict) -> List[str]:
@@ -63,6 +63,28 @@ def check_trace_obj(obj: dict) -> List[str]:
                                      counters["requests"])):
         if d > req:
             errs.append(f"round {r}: drops {d} > requests {req}")
+    # The active-rows gauge (pending at round entry) must never grow —
+    # done is monotone — and must be the exact complement of the
+    # previous round's done gauge (this survives merge_traces' fills:
+    # a converged chunk contributes 0 pending and L done).
+    active = counters["active_rows"]
+    if any(b > a for a, b in zip(active, active[1:])):
+        errs.append(f"active_rows gauge increased: {active}")
+    if n_lookups:
+        if active[0] != n_lookups:
+            errs.append(f"round 0 active_rows {active[0]} != "
+                        f"{n_lookups} lookups")
+        for r in range(1, rounds):
+            if active[r] != n_lookups - done[r - 1]:
+                errs.append(
+                    f"round {r}: active_rows {active[r]} != lookups - "
+                    f"done[{r - 1}] = {n_lookups - done[r - 1]}")
+                break
+        wasted = trace.get("wasted_row_rounds")
+        want_wasted = sum(n_lookups - a for a in active)
+        if wasted is not None and wasted != want_wasted:
+            errs.append(f"wasted_row_rounds {wasted} != sum(L - "
+                        f"active) = {want_wasted}")
 
     # Cross-check against the bench row the trace must explain.  The
     # chaos-lookup mode nests its traced leg's numbers under

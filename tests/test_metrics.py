@@ -272,10 +272,26 @@ class TestCheckTrace:
                     "requests": [16, 8], "replies": [64, 32],
                     "drops": [2, 0], "poison": [0, 0],
                     "strikes": [0, 0], "convictions": [0, 0],
-                    "churn": [30, 5], "done": [1, 4]},
-                "done_frac": [0.25, 1.0]},
+                    "churn": [30, 5], "done": [1, 4],
+                    "active_rows": [4, 3]},
+                "done_frac": [0.25, 1.0],
+                "wasted_row_rounds": 1},
             "hop_histogram": [0, 1, 3],
         }
+
+    def test_active_rows_invariants_flagged(self):
+        from opendht_tpu.tools.check_trace import check_trace_obj
+        bad = self._artifact()
+        bad["trace"]["counters"]["active_rows"] = [3, 4]   # grew
+        assert any("active_rows" in e for e in check_trace_obj(bad))
+        bad = self._artifact()
+        # breaks active[r] == n_lookups - done[r-1]
+        bad["trace"]["counters"]["active_rows"] = [4, 2]
+        assert any("active_rows" in e for e in check_trace_obj(bad))
+        bad = self._artifact()
+        bad["trace"]["wasted_row_rounds"] = 99
+        assert any("wasted_row_rounds" in e
+                   for e in check_trace_obj(bad))
 
     def test_valid_artifact_passes(self):
         from opendht_tpu.tools.check_trace import check_trace_obj
