@@ -28,22 +28,30 @@ bench:
 # lifecycle) and lookup (Byzantine responders + reply loss + the
 # strike/blacklist defense, defended vs undefended).
 # The 100k leg runs with the flight recorder ON (--trace-out) and the
-# artifact is then validated: parses, round counters monotone, and
-# consistent with the reported done_frac/recall — a bench whose trace
-# cannot explain its own numbers must not gate green.  The same
-# artifact then gates PERF: check_bench fails if lookups/s drops >5%
-# below the recorded r05 row (BENCH_GATE_r05.json, same-platform rate
+# artifact is then validated: parses, round counters monotone,
+# consistent with the reported done_frac/recall, and the round-9
+# phase-attribution fields (init/loop/finalize split + per-round wall
+# p50) self-consistent — a bench whose trace cannot explain its own
+# numbers must not gate green.  The same artifact then gates PERF:
+# check_bench fails if lookups/s drops >5% below the recorded r06 row
+# (BENCH_GATE_r06.json — the sort-free round core's rank-merge rate;
+# BENCH_GATE_r05.json stays for history; same-platform rate
 # comparison; recall_at_8/done_frac/median_hops gate on any platform).
-# The compaction-equivalence leg (tests/test_compaction.py, riding the
-# `test` prerequisite so it runs exactly once) re-proves the
-# straggler-harvesting ladder is bit-identical to the uncompacted
-# engines (plain, traced, chaos, sharded) before any number from it is
-# trusted; the dryrun asserts the same on the mesh.
+# The merge-equivalence leg (tests/test_merge_equivalence.py, explicit
+# below so a red merge can never hide behind an unrelated collection
+# error in the full run) re-proves the rank merge and the Pallas
+# round kernel bit-identical to the two-pass sorted reference on
+# adversarial inputs; the compaction-equivalence leg
+# (tests/test_compaction.py, riding the `test` prerequisite so it
+# runs exactly once) re-proves the straggler-harvesting ladder is
+# bit-identical to the uncompacted engines (plain, traced, chaos,
+# sharded); the dryrun asserts both on the mesh.
 gate: test
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	python -m pytest tests/test_merge_equivalence.py -q
 	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256 --trace-out /tmp/trace.json
 	python -m opendht_tpu.tools.check_trace /tmp/trace.json
-	python -m opendht_tpu.tools.check_bench /tmp/trace.json BENCH_GATE_r05.json
+	python -m opendht_tpu.tools.check_bench /tmp/trace.json BENCH_GATE_r06.json
 	python bench.py --mode chaos --nodes 16384 --puts 2048
 	python bench.py --mode chaos-lookup --nodes 16384 --lookups 4096 --recall-sample 256
 

@@ -97,6 +97,15 @@ def main():
                          "(auto = on; off = full-width dispatch every "
                          "round, the pre-ladder engine — for A/B "
                          "attribution)")
+    ap.add_argument("--merge-impl",
+                    choices=("auto", "xla", "xla-sort", "pallas"),
+                    default="auto",
+                    help="round-merge micro-architecture (auto = fused "
+                         "Pallas kernel on TPU, XLA rank-merge "
+                         "elsewhere; xla-sort = the pre-round-9 "
+                         "two-pass sorted merge — for A/B "
+                         "attribution; pallas off-TPU runs the "
+                         "interpreter and is for tests only)")
     ap.add_argument("--recall-sample", type=int, default=512)
     ap.add_argument("--mode",
                     choices=("lookups", "putget", "churn", "crawl",
@@ -194,11 +203,12 @@ def main():
         return chaos_main(args)
 
     from opendht_tpu.models.swarm import (
-        SwarmConfig, build_swarm, lookup, merge_traces, traced_lookup,
-        true_closest,
+        SwarmConfig, build_swarm, lookup, merge_traces,
+        resolve_merge_impl, traced_lookup, true_closest,
     )
 
     kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    kw["merge_impl"] = args.merge_impl
     cfg = SwarmConfig.for_nodes(args.nodes, **kw)
     key = jax.random.PRNGKey(0)
     swarm = build_swarm(key, cfg)
@@ -267,6 +277,81 @@ def main():
     res = LookupResultConcat(ress)
     hops = np.asarray(res.hops)
 
+    # Phase attribution (round-9 satellite): ONE extra UNTIMED pass
+    # with block_until_ready barriers between init / loop / finalize
+    # (the barriers de-pipeline the device queue, so attribution never
+    # rides — or perturbs — the timed runs above), plus per-round wall
+    # estimates from the burst clocks (burst wall / rounds-in-burst;
+    # rounds inside a burst pipeline with no sync, so that quotient is
+    # the honest per-round figure).
+    phase, round_p50 = None, None
+    if compact:
+        pstats = [dict(time_phases=True) for _ in chunks]
+        # Reuse whichever engine the timed runs already compiled (the
+        # traced one under --trace-out): attribution must not pay a
+        # fresh jit of the other engine's step and book it as loop
+        # time.
+        if use_trace:
+            rs = [traced_lookup(swarm, cfg, c,
+                                jax.random.PRNGKey(900 + i),
+                                compact=True, stats=pstats[i])[0]
+                  for i, c in enumerate(chunks)]
+        else:
+            rs = [lookup(swarm, cfg, c, jax.random.PRNGKey(900 + i),
+                         compact=True, stats=pstats[i])
+                  for i, c in enumerate(chunks)]
+        for r in rs:
+            sync(r)
+        per_round = [wall / n for s in pstats
+                     for wall, n in s.get("burst_walls", ())
+                     for _ in range(n)]
+        phase = {
+            "init_s": round(sum(s["init_s"] for s in pstats), 4),
+            "loop_s": round(sum(s["loop_s"] for s in pstats), 4),
+            "finalize_s": round(sum(s["finalize_s"] for s in pstats),
+                                4),
+            "total_s": round(sum(s["phase_total_s"] for s in pstats),
+                             4),
+        }
+        if per_round:
+            round_p50 = round(float(np.percentile(per_round, 50)), 5)
+
+    # Tier-2 attribution: where the fused Pallas round kernel is the
+    # resolved hot path (TPU), also time the XLA rank-merge variant so
+    # the BENCH row reports the Pallas-vs-XLA delta on the same
+    # machine.  Never runs off-TPU (auto resolves to the rank merge
+    # there, and interpret-mode Pallas must stay off hot paths).
+    merge_impl = resolve_merge_impl(cfg)
+    pallas_delta = None
+    if merge_impl == "pallas":
+        cfg_x = cfg._replace(merge_impl="xla")
+
+        def run_xla(seed):
+            # Same engine as the timed runs (traced under --trace-out):
+            # the A/B must compare like with like, or the recorder's
+            # capture cost would bias the reported delta.
+            if use_trace:
+                rs = [traced_lookup(swarm, cfg_x, c,
+                                    jax.random.PRNGKey(seed + i),
+                                    compact=compact)[0]
+                      for i, c in enumerate(chunks)]
+            else:
+                rs = [lookup(swarm, cfg_x, c,
+                             jax.random.PRNGKey(seed + i),
+                             compact=compact)
+                      for i, c in enumerate(chunks)]
+            for r in rs:
+                sync(r)
+
+        run_xla(2)
+        tx = []
+        for i in range(max(1, args.repeat - 1)):
+            t0 = time.perf_counter()
+            run_xla(700 + 100 * i)
+            tx.append(time.perf_counter() - t0)
+        pallas_delta = {"xla_merge_wall_s": round(min(tx), 4),
+                        "pallas_vs_xla_speedup": round(min(tx) / dt, 3)}
+
     # Recall on a subsample (exact k-closest over the full matrix is
     # O(L·N); sample keeps it cheap).  Recall is an auxiliary metric:
     # any failure here (e.g. a kernel config that fails to compile at
@@ -305,8 +390,15 @@ def main():
         "done_frac": float(np.asarray(res.done).mean()),
         "recall_at_8": round(recall, 4) if recall is not None else None,
         "compact": compact,
+        "merge_impl": merge_impl,
         "platform": jax.devices()[0].platform,
     }
+    if phase is not None:
+        out["phase_wall"] = phase
+    if round_p50 is not None:
+        out["round_wall_p50"] = round_p50
+    if pallas_delta is not None:
+        out.update(pallas_delta)
     if chunk_stats:
         # Dispatch attribution for the compaction ladder: how many
         # rounds actually ran and what fraction of the batch width they
@@ -743,6 +835,7 @@ def sharded_main(args):
     n_dev = len(jax.devices())
     mesh = make_mesh(n_dev)
     kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    kw["merge_impl"] = args.merge_impl
     cfg = SwarmConfig.for_nodes(args.nodes, **kw)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
     _ = np.asarray(swarm.tables[:1, :1])
@@ -1201,6 +1294,7 @@ def chaos_lookup_main(args):
     )
 
     kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    kw["merge_impl"] = args.merge_impl
     cfg = SwarmConfig.for_nodes(args.nodes, **kw)
     swarm = build_swarm(jax.random.PRNGKey(0), cfg)
     _ = np.asarray(swarm.tables[:1, :1])

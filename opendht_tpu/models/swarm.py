@@ -36,6 +36,7 @@ the lookup batch axis shards cleanly over a mesh (see
 from __future__ import annotations
 
 import math
+import time
 from functools import partial
 from typing import NamedTuple
 
@@ -48,6 +49,7 @@ from ..ops.xor_metric import (
     lex_searchsorted,
     merge_shortlists_d0,
     prefix_len32,
+    rank_merge_round_d0,
 )
 
 UINT32_MAX = 0xFFFFFFFF
@@ -146,6 +148,24 @@ class SwarmConfig(NamedTuple):
     # 8 B/entry, which is what lets the fast path fit 10M nodes on a
     # 16 GB chip (10.1 GB vs 13.4 GB).
     aug_tables: bool = True
+    # Round-merge micro-architecture (static, part of the jit key):
+    #   "auto"     — Pallas fused round kernel on TPU, XLA rank-merge
+    #                everywhere else (Pallas NEVER runs in interpret
+    #                mode on a hot path);
+    #   "xla"      — sort-free rank-based merge
+    #                (ops.xor_metric.rank_merge_round_d0): dedups
+    #                responses by membership/earlier-slot planes and
+    #                computes every survivor's output slot by rank
+    #                arithmetic over the already-sorted frontier — no
+    #                sort over any candidate width;
+    #   "xla-sort" — the two-pass full-width sorted merge
+    #                (merge_shortlists_d0 over the concatenated
+    #                candidates) — the pre-round-9 reference path the
+    #                equivalence suite pins the others against;
+    #   "pallas"   — the fused dedup+merge+quorum Pallas kernel
+    #                (ops.pallas_kernels.merge_round_pallas); interpret
+    #                mode off-TPU, so only tests should force it there.
+    merge_impl: str = "auto"
 
     @classmethod
     def for_nodes(cls, n_nodes: int, **kw) -> "SwarmConfig":
@@ -179,6 +199,9 @@ class SwarmConfig(NamedTuple):
 _swarmconfig_new = SwarmConfig.__new__
 
 
+MERGE_IMPLS = ("auto", "xla", "xla-sort", "pallas")
+
+
 def _swarmconfig_checked_new(cls, *args, **kw):
     cfg = _swarmconfig_new(cls, *args, **kw)
     if cfg.quorum + 2 > cfg.search_width:
@@ -187,10 +210,27 @@ def _swarmconfig_checked_new(cls, *args, **kw):
             f"_finalize exact re-sort covers the top quorum+2 surrogate "
             f"ranks — see BASELINE.md sim_fidelity); got quorum="
             f"{cfg.quorum}, search_width={cfg.search_width}")
+    if cfg.merge_impl not in MERGE_IMPLS:
+        raise ValueError(
+            f"SwarmConfig.merge_impl must be one of {MERGE_IMPLS}; "
+            f"got {cfg.merge_impl!r}")
     return cfg
 
 
 SwarmConfig.__new__ = _swarmconfig_checked_new
+
+
+def resolve_merge_impl(cfg: SwarmConfig) -> str:
+    """Concrete round-merge implementation for this run.
+
+    ``auto`` picks the fused Pallas kernel only where it compiles to
+    real TPU code; every other backend gets the XLA rank-merge — the
+    CPU gate must never pay Pallas interpret mode on the hot path.
+    Resolved at trace time (the backend choice is process-stable).
+    """
+    if cfg.merge_impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return cfg.merge_impl
 
 
 class Swarm(NamedTuple):
@@ -805,23 +845,33 @@ def _gather_span(tables: jax.Array, node: jax.Array, start: jax.Array,
 
 
 def _select_alpha(st: LookupState, cfg: SwarmConfig):
-    """α best unqueried shortlist nodes per lookup, with their d0.
+    """α best unqueried shortlist nodes per lookup, with their d0 and
+    shortlist slot.
 
     The shortlist is already distance-sorted, so the α best unqueried
-    are the first α unqueried slots; each is extracted with one masked
-    reduction (at most one slot per row has rank j), which beats a
-    sort for α ≪ S.  Returns ``(sel [L,A] int32, sel_d0 [L,A])`` —
-    the d0 rides along so responders can derive their bucket index
-    without touching the id matrix.
+    are the first α unqueried slots.  One vectorized one-hot
+    extraction: the unqueried-rank cumsum compared against
+    ``arange(alpha)`` gives a single ``[L,S,A]`` selection tensor
+    (at most one slot per (row, rank) pair), contracted by three
+    max-reductions — replacing the former per-rank Python loop whose
+    HLO grew linearly with α.  Returns ``(sel [L,A] int32, sel_d0
+    [L,A], sel_pos [L,A] int32)``: the d0 rides along so responders
+    can derive their bucket index without touching the id matrix, and
+    the slot position lets the round tail scatter the queried/evict
+    updates straight back instead of re-matching ``sel`` against the
+    whole shortlist (the old ``[L,S,A]`` hit tensor).
     """
     unq = (st.idx >= 0) & ~st.queried
     order = jnp.cumsum(unq.astype(jnp.int32), axis=1)
-    sel, sel_d0 = [], []
-    for j in range(cfg.alpha):
-        m = unq & (order == j + 1)
-        sel.append(jnp.max(jnp.where(m, st.idx, -1), axis=1))
-        sel_d0.append(jnp.max(jnp.where(m, st.dist, 0), axis=1))
-    return jnp.stack(sel, axis=1), jnp.stack(sel_d0, axis=1)
+    oh = unq[:, :, None] & (
+        order[:, :, None] == jnp.arange(1, cfg.alpha + 1,
+                                        dtype=jnp.int32)[None, None, :])
+    sel = jnp.max(jnp.where(oh, st.idx[:, :, None], -1), axis=1)
+    sel_d0 = jnp.max(jnp.where(oh, st.dist[:, :, None],
+                               jnp.uint32(0)), axis=1)
+    slots = jnp.arange(st.idx.shape[1], dtype=jnp.int32)[None, :, None]
+    sel_pos = jnp.max(jnp.where(oh, slots, -1), axis=1)
+    return sel, sel_d0, sel_pos
 
 
 def _sync_done(st_idx: jax.Array, st_queried: jax.Array,
@@ -879,18 +929,19 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     # Finished lookups stop soliciting: besides wasting gathers, their
     # traffic would consume bounded all_to_all capacity and could
     # starve still-active queries on a hot shard.
-    sel, sel_d0 = _select_alpha(st, cfg)                        # [L,A]
+    sel, sel_d0, sel_pos = _select_alpha(st, cfg)               # [L,A]
     sel = jnp.where(st.done[:, None], -1, sel)
     sel_alive = (sel >= 0) & alive[jnp.clip(sel, 0, cfg.n_nodes - 1)]
     resp, resp_d0, answered = respond(st.targets, sel, sel_d0)  # [L,A*2K]
-    return _merge_round(st, cfg, sel, sel_alive, answered, resp,
-                        resp_d0, trace=trace, rnd=rnd,
+    return _merge_round(st, cfg, sel, sel_pos, sel_alive, answered,
+                        resp, resp_d0, trace=trace, rnd=rnd,
                         done_base=done_base)
 
 
 def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
-                 sel_alive: jax.Array, answered: jax.Array,
-                 resp: jax.Array, resp_d0: jax.Array,
+                 sel_pos: jax.Array, sel_alive: jax.Array,
+                 answered: jax.Array, resp: jax.Array,
+                 resp_d0: jax.Array,
                  trace: LookupTrace | None = None,
                  rnd: jax.Array | None = None, done_base: int = 0):
     """Round tail shared by the plain and chaos engines: fold the α
@@ -905,26 +956,54 @@ def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
     it with the next candidate (request.h:113, src/dht.cpp:1059-1074).
     Alive-but-unanswered (transport drop) stays unqueried and is
     re-solicited next round.
+
+    ``sel_pos`` is each solicitation's shortlist slot (from
+    ``_select_alpha``): the queried/evict updates scatter straight to
+    those slots — the shortlist is duplicate-free and unchanged since
+    selection, so the old ``[L,S,α]`` equality hit tensor resolved to
+    exactly these positions.  The merge itself dispatches on
+    ``SwarmConfig.merge_impl`` (see :func:`resolve_merge_impl`): the
+    sort-free rank merge, the fused Pallas round kernel, or the
+    two-pass sorted reference — all bit-identical on this input domain
+    (``tests/test_merge_equivalence.py``).
     """
-    hit = st.idx[:, :, None] == sel[:, None, :]                 # [L,S,A]
-    hit = hit & (sel[:, None, :] >= 0)
-    queried = st.queried | jnp.any(
-        hit & (sel_alive & answered)[:, None, :], axis=2)
-    evict = jnp.any(hit & (~sel_alive & (sel >= 0))[:, None, :], axis=2)
+    l, s_w = st.idx.shape
+    rows = jnp.arange(l, dtype=jnp.int32)[:, None]
+    valid_sel = sel >= 0
+    q_hit = valid_sel & sel_alive & answered
+    e_hit = valid_sel & ~sel_alive
+    queried = st.queried.at[
+        rows, jnp.where(q_hit, sel_pos, s_w)].set(True, mode="drop")
+    evict = jnp.zeros_like(st.queried).at[
+        rows, jnp.where(e_hit, sel_pos, s_w)].set(True, mode="drop")
     idx = jnp.where(evict, -1, st.idx)
-    cand_idx = jnp.concatenate([idx, resp], axis=1)
     # Evicted frontier slots must not keep their old (now invalid)
     # distance keys.
     fr_dist = jnp.where(evict, jnp.uint32(UINT32_MAX), st.dist)
-    cand_dist = jnp.concatenate([fr_dist, resp_d0], axis=1)
-    cand_q = jnp.concatenate(
-        [queried, jnp.zeros_like(resp, bool)], axis=1)
-    f_idx, f_dist, f_q = merge_shortlists_d0(
-        cand_dist, cand_idx, cand_q, keep=cfg.search_width)
+    impl = resolve_merge_impl(cfg)
+    done_merge = None
+    if impl == "pallas":
+        from ..ops.pallas_kernels import merge_round_pallas
+        f_idx, f_dist, f_q, done_merge = merge_round_pallas(
+            idx, fr_dist, queried, resp, resp_d0,
+            quorum=cfg.quorum, keep=cfg.search_width)
+    elif impl == "xla":
+        f_idx, f_dist, f_q = rank_merge_round_d0(
+            idx, fr_dist, queried, resp, resp_d0,
+            keep=cfg.search_width)
+    else:                                               # "xla-sort"
+        cand_idx = jnp.concatenate([idx, resp], axis=1)
+        cand_dist = jnp.concatenate([fr_dist, resp_d0], axis=1)
+        cand_q = jnp.concatenate(
+            [queried, jnp.zeros_like(resp, bool)], axis=1)
+        f_idx, f_dist, f_q = merge_shortlists_d0(
+            cand_dist, cand_idx, cand_q, keep=cfg.search_width)
 
     active = ~st.done & jnp.any(sel >= 0, axis=1)
-    done = st.done | _sync_done(f_idx, f_q, cfg) | ~jnp.any(
-        (f_idx >= 0) & ~f_q, axis=1)
+    if done_merge is None:
+        done_merge = _sync_done(f_idx, f_q, cfg) | ~jnp.any(
+            (f_idx >= 0) & ~f_q, axis=1)
+    done = st.done | done_merge
     # No done-freeze copies: a done lookup solicits nobody (sel = -1),
     # so its merge inputs are its own shortlist plus invalid slots, and
     # the two-pass stable merge is idempotent on an already-merged
@@ -1063,9 +1142,20 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     :func:`run_compacted_burst_loop`).
     """
     l = targets.shape[0]
+    # Phase attribution (bench satellite): with ``stats["time_phases"]``
+    # set, wall time is split init / loop / finalize with a
+    # ``block_until_ready`` barrier between phases.  The barriers
+    # de-pipeline the device queue, so attribution runs are SEPARATE
+    # from rate measurements (bench.py runs one extra untimed pass).
+    timing = bool(stats) and stats.get("time_phases")
+    t0 = time.perf_counter() if timing else 0.0
     # Origins are drawn from *alive* nodes: the issuing node exists.
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
+    if timing:
+        jax.block_until_ready(st)
+        t1 = time.perf_counter()
+        stats["init_s"] = t1 - t0
     if not compact:
         st = run_burst_loop(lambda s, r: lookup_step(swarm, cfg, s), st,
                             cfg)
@@ -1074,7 +1164,16 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     st, _, order = run_compacted_burst_loop(
         lambda s, ex, r, hidden: (_lookup_step_d(swarm, cfg, s), ex),
         st, cfg, stats=stats)
+    if timing:
+        jax.block_until_ready(st)
+        t2 = time.perf_counter()
+        stats["loop_s"] = t2 - t1
     found, hops, done = _finalize_scattered(swarm.ids, st, order, cfg)
+    if timing:
+        jax.block_until_ready((found, hops, done))
+        t3 = time.perf_counter()
+        stats["finalize_s"] = t3 - t2
+        stats["phase_total_s"] = t3 - t0
     return LookupResult(found=found, hops=hops, done=done)
 
 
@@ -1246,11 +1345,18 @@ def run_compacted_burst_loop(step_fn, st: LookupState, cfg: SwarmConfig,
     # the wasted row-rounds are — the cost is ONE extra done-check
     # readback vs aiming the whole depth.
     burst = max(2, burst_schedule(cfg) - 2)
+    # Per-burst wall clocks for the bench's per-round attribution:
+    # rounds inside a burst pipeline with no sync, so the honest
+    # per-round figure is burst wall (including its done-check
+    # readback, the barrier the loop pays anyway) divided by the
+    # burst's round count.
+    timing = stats is not None and stats.get("time_phases")
     rounds = 0
     row_rounds = 0
     widths = []
     while rounds < cfg.max_steps:
         n = min(burst, cfg.max_steps - rounds)
+        tb = time.perf_counter() if timing else 0.0
         for _ in range(n):
             sub, extras = step_fn(sub, extras, rounds, l - w)
             rounds += 1
@@ -1258,6 +1364,9 @@ def run_compacted_burst_loop(step_fn, st: LookupState, cfg: SwarmConfig,
         if w not in widths:
             widths.append(w)
         pending = int(jnp.sum(~sub.done))
+        if timing:
+            stats.setdefault("burst_walls", []).append(
+                (time.perf_counter() - tb, n))
         if pending == 0:
             break
         burst = 2
@@ -1318,9 +1427,15 @@ def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     as an uncompacted one (asserted in ``tests/test_compaction.py``).
     """
     l = targets.shape[0]
+    timing = bool(stats) and stats.get("time_phases")
+    t0 = time.perf_counter() if timing else 0.0
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
     trace = empty_lookup_trace(cfg)
+    if timing:
+        jax.block_until_ready(st)
+        t1 = time.perf_counter()
+        stats["init_s"] = t1 - t0
     if not compact:
         st, trace = run_burst_loop(
             lambda c, r: traced_lookup_step(swarm, cfg, c[0], c[1],
@@ -1336,7 +1451,16 @@ def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
 
     st, (trace,), order = run_compacted_burst_loop(
         step, st, cfg, extras=(trace,), stats=stats)
+    if timing:
+        jax.block_until_ready(st)
+        t2 = time.perf_counter()
+        stats["loop_s"] = t2 - t1
     found, hops, done = _finalize_scattered(swarm.ids, st, order, cfg)
+    if timing:
+        jax.block_until_ready((found, hops, done))
+        t3 = time.perf_counter()
+        stats["finalize_s"] = t3 - t2
+        stats["phase_total_s"] = t3 - t0
     return (LookupResult(found=found, hops=hops, done=done), trace)
 
 
@@ -1570,7 +1694,7 @@ def chaos_step_impl(ids: jax.Array, alive: jax.Array,
             dist=jnp.where(conv, jnp.uint32(UINT32_MAX), st.dist),
             queried=st.queried & ~conv)
 
-    sel, sel_d0 = _select_alpha(st, cfg)
+    sel, sel_d0, sel_pos = _select_alpha(st, cfg)
     sel = jnp.where(st.done[:, None], -1, sel)
     safe = jnp.clip(sel, 0, n - 1)
     valid = sel >= 0
@@ -1646,8 +1770,8 @@ def chaos_step_impl(ids: jax.Array, alive: jax.Array,
     # poisoned/blacklisted response slots were invalidated above, and
     # convicted RESPONDERS leave shortlists at the next round's
     # blacklist eviction (plus the final _censor_convicted pass).
-    merged = _merge_round(st, cfg, sel, sel_alive, answered, resp,
-                          resp_d0, trace=trace, rnd=rnd,
+    merged = _merge_round(st, cfg, sel, sel_pos, sel_alive, answered,
+                          resp, resp_d0, trace=trace, rnd=rnd,
                           done_base=done_base)
     if trace is None:
         new_st = merged

@@ -8,8 +8,13 @@ from .xor_metric import (  # noqa: F401
     merge_shortlists,
     merge_shortlists_d0,
     prefix_len32,
+    rank_merge_round_d0,
     sort_by_distance,
     xor_ids,
     xor_less,
 )
-from .pallas_kernels import nearest_ids, nearest_k_ids  # noqa: F401
+from .pallas_kernels import (  # noqa: F401
+    merge_round_pallas,
+    nearest_ids,
+    nearest_k_ids,
+)

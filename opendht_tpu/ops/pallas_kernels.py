@@ -224,6 +224,146 @@ def nearest_k_ids(ids: jax.Array, targets: jax.Array, k: int = 8, *,
     return sorted_[N_LIMBS][:, :k]
 
 
+# ---------------------------------------------------------------------------
+# fused lookup-round merge kernel
+# ---------------------------------------------------------------------------
+
+def _merge_round_kernel(fi_ref, fd_ref, fq_ref, ri_ref, rd_ref,
+                        oi_ref, od_ref, oq_ref, dn_ref, *,
+                        s: int, c: int, keep: int, quorum: int):
+    """One fused lookup round tail: dedup + rank-merge + quorum check,
+    frontier resident in VMEM throughout.
+
+    Inputs per tile: frontier ``fi/fd/fq [TL, S]`` (idx i32 / d0 u32 /
+    queried i32), responses ``ri/rd [TL, C]``.  Outputs: merged
+    ``oi/od/oq [TL, keep]`` plus the fused done contribution
+    ``dn [TL, 1]`` (sync-quorum OR exhaustion).
+
+    Semantics are EXACTLY the sort-free rank merge
+    (:func:`opendht_tpu.ops.xor_metric.rank_merge_round_d0` — see its
+    contract): every entry's output slot is its rank under the total
+    order ``(effective d0, idx_u, input ordinal)`` with duplicates'
+    and empties' d0 forced to all-ones, computed here by direct
+    counting — all loops below are static unrolls over the tiny
+    S/C/keep widths, every op an [TL, W]-shaped VPU op, no sort
+    network anywhere.
+    """
+    maxu = jnp.uint32(0xFFFFFFFF)
+    fi = fi_ref[...]
+    fd = fd_ref[...]
+    fq = fq_ref[...]
+    ri = ri_ref[...]
+    rd = rd_ref[...]
+    tl = fi.shape[0]
+    w = s + c
+
+    idx = jnp.concatenate([fi, ri], axis=1)                  # [TL, W]
+    d0 = jnp.concatenate([fd, rd], axis=1)
+    q = jnp.concatenate([fq, jnp.zeros_like(ri)], axis=1)
+    idxu = jax.lax.bitcast_convert_type(idx, jnp.uint32)
+    invalid = idx < 0
+    d0 = jnp.where(invalid, maxu, d0)
+
+    # Dedup: a response duplicates any EARLIER entry with its index
+    # (the frontier run, or an earlier response slot — first copy
+    # wins).  Frontier entries are duplicate-free by contract.
+    dcols = [jnp.zeros((tl, 1), dtype=jnp.bool_) for _ in range(s)]
+    for j in range(s, w):
+        eq = (idxu[:, :j] == idxu[:, j:j + 1]) & ~invalid[:, :j]
+        dcols.append(jnp.any(eq, axis=1, keepdims=True))
+    dup = jnp.concatenate(dcols, axis=1) | invalid           # [TL, W]
+    eff = jnp.where(dup, maxu, d0)
+
+    # Rank = count of entries strictly before under
+    # (eff_d0, idx_u, ordinal) — the merge-path position.
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (tl, w), 1)
+    pcols = []
+    for j in range(w):
+        kd = eff[:, j:j + 1]
+        ki = idxu[:, j:j + 1]
+        lt = (eff < kd) | ((eff == kd)
+                           & ((idxu < ki)
+                              | ((idxu == ki) & (iota_w < j))))
+        pcols.append(jnp.sum(lt.astype(jnp.int32), axis=1,
+                             keepdims=True))
+    pos = jnp.concatenate(pcols, axis=1)                     # [TL, W]
+
+    # One-hot placement of the surviving entries; dropped/duplicate
+    # slots keep the fill (idx -1, d0 all-ones, unqueried), exactly
+    # like the scatter in the XLA rank merge.
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (tl, keep), 1)
+    oi = jnp.full((tl, keep), -1, jnp.int32)
+    od = jnp.full((tl, keep), maxu, jnp.uint32)
+    oq = jnp.zeros((tl, keep), jnp.int32)
+    for j in range(w):
+        hit = (iota_k == pos[:, j:j + 1]) & ~dup[:, j:j + 1]
+        oi = jnp.where(hit, idx[:, j:j + 1], oi)
+        od = jnp.where(hit, d0[:, j:j + 1], od)
+        oq = jnp.where(hit, q[:, j:j + 1], oq)
+
+    # Fused quorum/exhaustion check (models.swarm._sync_done + the
+    # nothing-left-unqueried exit), while the merged head is in VMEM.
+    hv = oi[:, :quorum] >= 0
+    synced = jnp.all(jnp.where(hv, oq[:, :quorum] != 0, True), axis=1,
+                     keepdims=True) & jnp.any(hv, axis=1, keepdims=True)
+    exhausted = ~jnp.any((oi >= 0) & (oq == 0), axis=1, keepdims=True)
+    oi_ref[...] = oi
+    od_ref[...] = od
+    oq_ref[...] = oq
+    dn_ref[...] = (synced | exhausted).astype(jnp.int32)
+
+
+@partial(jax.jit,
+         static_argnames=("quorum", "keep", "tile_l", "interpret"))
+def merge_round_pallas(fr_idx: jax.Array, fr_d0: jax.Array,
+                       fr_q: jax.Array, resp_idx: jax.Array,
+                       resp_d0: jax.Array, *, quorum: int, keep: int,
+                       tile_l: int = 256,
+                       interpret: bool | None = None):
+    """Fused lookup-round merge: dedup + merge + quorum check in one
+    Pallas kernel, grid over lookup-row tiles.
+
+    ``fr_idx/fr_d0/fr_q [L,S]``: the frontier (post queried/evict
+    updates — rank_merge_round_d0's input contract); ``resp_idx/
+    resp_d0 [L,C]``: the α·2K response block.  Returns ``(idx, d0,
+    queried, done)`` with the first three ``[L, min(keep, S+C)]`` and
+    ``done [L] bool`` the fused sync-quorum/exhaustion contribution.
+
+    Bit-identical to the XLA rank merge (and hence to the two-pass
+    sorted reference) on the round's input domain — asserted under
+    ``interpret=True`` in ``tests/test_merge_equivalence.py``.  Off-TPU
+    backends run the interpreter, which is for those tests ONLY: the
+    hot-path dispatch (``models.swarm.resolve_merge_impl``) never
+    selects this kernel off-TPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    l, s = fr_idx.shape
+    c = resp_idx.shape[1]
+    out_w = min(keep, s + c)
+    fi = _pad_to(fr_idx, tile_l, 0, -1)
+    fd = _pad_to(fr_d0.astype(jnp.uint32), tile_l, 0, _MAX)
+    fq = _pad_to(fr_q.astype(jnp.int32), tile_l, 0, 0)
+    ri = _pad_to(resp_idx, tile_l, 0, -1)
+    rd = _pad_to(resp_d0.astype(jnp.uint32), tile_l, 0, _MAX)
+    lp = fi.shape[0]
+    grid = (lp // tile_l,)
+    row = lambda width: pl.BlockSpec((tile_l, width), lambda i: (i, 0))
+    oi, od, oq, dn = pl.pallas_call(
+        partial(_merge_round_kernel, s=s, c=c, keep=out_w,
+                quorum=quorum),
+        grid=grid,
+        in_specs=[row(s), row(s), row(s), row(c), row(c)],
+        out_specs=(row(out_w), row(out_w), row(out_w), row(1)),
+        out_shape=(jax.ShapeDtypeStruct((lp, out_w), jnp.int32),
+                   jax.ShapeDtypeStruct((lp, out_w), jnp.uint32),
+                   jax.ShapeDtypeStruct((lp, out_w), jnp.int32),
+                   jax.ShapeDtypeStruct((lp, 1), jnp.int32)),
+        interpret=interpret,
+    )(fi, fd, fq, ri, rd)
+    return oi[:l], od[:l], oq[:l] != 0, dn[:l, 0] != 0
+
+
 @partial(jax.jit, static_argnames=("tile_l", "tile_n", "interpret"))
 def nearest_ids(ids: jax.Array, targets: jax.Array, *, tile_l: int = 256,
                 tile_n: int = 1024, interpret: bool | None = None

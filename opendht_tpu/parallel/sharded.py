@@ -983,7 +983,7 @@ def contended_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         st, dropped, attempted = carry
         # Same selection the step will make — counters see exactly the
         # queries the capacity rule saw.
-        sel, _ = _select_alpha(st, cfg)
+        sel, _, _ = _select_alpha(st, cfg)
         sel = jnp.where(st.done[:, None], -1, sel)
         sent, ok = sent_mask(sel)
         dropped += jnp.sum(ok.reshape(sel.shape) & ~sent)

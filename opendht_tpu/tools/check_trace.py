@@ -107,6 +107,35 @@ def check_trace_obj(obj: dict) -> List[str]:
     if recall and recall > 0 and done[-1] == 0:
         errs.append(f"bench reports recall {recall} but the trace saw "
                     f"0 lookups converge")
+
+    # Phase attribution (round 9): when the bench row carries the
+    # init/loop/finalize split, the parts must be non-negative and sum
+    # to the attribution pass's total (they are measured back-to-back,
+    # so only the per-field rounding can open a gap), and the per-round
+    # p50 must be a positive figure that fits inside the loop phase.
+    phase = bench.get("phase_wall")
+    if phase is not None:
+        parts = ("init_s", "loop_s", "finalize_s", "total_s")
+        missing = [p for p in parts if not isinstance(
+            phase.get(p), (int, float))]
+        if missing:
+            errs.append(f"phase_wall missing/non-numeric {missing}")
+        else:
+            if any(phase[p] < 0 for p in parts):
+                errs.append(f"phase_wall has negative phases: {phase}")
+            gap = abs(phase["init_s"] + phase["loop_s"]
+                      + phase["finalize_s"] - phase["total_s"])
+            if gap > max(1e-3, 0.01 * phase["total_s"]):
+                errs.append(f"phase_wall parts sum off total by "
+                            f"{gap:.4f}s: {phase}")
+    p50 = bench.get("round_wall_p50")
+    if p50 is not None:
+        if not (isinstance(p50, (int, float)) and p50 > 0):
+            errs.append(f"round_wall_p50 not a positive number: {p50}")
+        elif phase is not None and not missing \
+                and p50 > phase["loop_s"] + 1e-9:
+            errs.append(f"round_wall_p50 {p50} exceeds the whole loop "
+                        f"phase {phase['loop_s']}")
     return errs
 
 

@@ -1,0 +1,256 @@
+"""Sort-free round core: the rank-based merge and the fused Pallas
+round kernel must be BIT-EQUAL to the two-pass sorted reference
+(``merge_shortlists_d0``) on the lookup round's input domain, and the
+engines must be bit-identical across ``SwarmConfig.merge_impl``
+choices.
+
+The input domain (rank_merge_round_d0's contract) is what every
+``_merge_round`` call satisfies: a frontier whose VALID entries are
+``(d0, idx_u)``-sorted and duplicate-free (holes anywhere — evicted
+slots keep arbitrary queried flags), and an arbitrary unqueried
+response block.  The adversarial generators below deliberately hit the
+documented corner rules: duplicate ids carrying DIFFERENT
+window-surrogate d0s (the kept copy must be the frontier's, with its
+d0 and queried flag), live candidates whose d0 is exactly the
+0xFFFFFFFF empty sentinel (they rank by their real index among the
+all-ones group), all-invalid rows, evicted frontier slots, and
+``keep`` wider than the candidate block.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.models.swarm import (
+    LookupFaults,
+    SwarmConfig,
+    build_swarm,
+    chaos_lookup,
+    churn,
+    corrupt_swarm,
+    lookup,
+    resolve_merge_impl,
+    traced_lookup,
+)
+from opendht_tpu.ops.pallas_kernels import merge_round_pallas
+from opendht_tpu.ops.xor_metric import (
+    merge_shortlists_d0,
+    rank_merge_round_d0,
+)
+
+L, S, C, NN = 64, 14, 32, 500
+MAXU = np.uint32(0xFFFFFFFF)
+
+
+def ref_merge(fi, fd, fq, ri, rd, keep):
+    """The two-pass sorted reference on the concatenated candidates."""
+    return merge_shortlists_d0(
+        jnp.concatenate([fd, rd], axis=1),
+        jnp.concatenate([fi, ri], axis=1),
+        jnp.concatenate([fq, jnp.zeros_like(ri, dtype=bool)], axis=1),
+        keep)
+
+
+def make_frontier(seed, evict_frac=0.25):
+    """A frontier satisfying the round invariant: the output of the
+    reference merge on random candidates (valid prefix sorted and
+    dup-free), then eviction holes punched the way ``_merge_round``
+    punches them (idx -1, d0 all-ones, queried flag KEPT)."""
+    r = np.random.default_rng(seed)
+    cd0 = jnp.asarray(r.integers(0, 2**32, (L, S + C), dtype=np.uint32))
+    ci = jnp.asarray(r.integers(-1, NN, (L, S + C), dtype=np.int32))
+    cq = jnp.asarray(r.random((L, S + C)) < 0.5) & (ci >= 0)
+    fi, fd, fq = merge_shortlists_d0(cd0, ci, cq, keep=S)
+    ev = jnp.asarray(r.random((L, S)) < evict_frac)
+    return (jnp.where(ev, -1, fi), jnp.where(ev, MAXU, fd), fq)
+
+
+def adversarial_responses(seed, fi):
+    """Responses hitting every documented corner: frontier duplicates
+    with DIFFERENT d0s (the window-surrogate case), repeated response
+    ids with different d0s, exact-sentinel d0 live candidates, and
+    invalid slots."""
+    r = np.random.default_rng(seed)
+    ri = r.integers(-1, NN, (L, C), dtype=np.int32)
+    take = r.integers(0, S, (L, C // 4))
+    ri[:, :C // 4] = np.asarray(fi)[np.arange(L)[:, None], take]
+    ri[:, C // 2] = ri[:, C // 2 + 1]         # within-block duplicate
+    rd = r.integers(0, 2**32, (L, C), dtype=np.uint32)
+    rd[:, 5] = MAXU                           # live sentinel-d0 rows
+    return jnp.asarray(ri), jnp.asarray(rd)
+
+
+def assert_bit_equal(a, b, what):
+    for x, y, name in zip(a, b, ("idx", "d0", "queried")):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            f"{what}: {name} diverged"
+
+
+class TestRankMergeEquivalence:
+    @pytest.mark.parametrize("seed", range(8))
+    @pytest.mark.parametrize("keep", [S, 3, S + C + 5])
+    def test_adversarial_bit_equal(self, seed, keep):
+        fi, fd, fq = make_frontier(seed)
+        ri, rd = adversarial_responses(1000 + seed, fi)
+        a = ref_merge(fi, fd, fq, ri, rd, keep)
+        b = rank_merge_round_d0(fi, fd, fq, ri, rd, keep)
+        assert_bit_equal(a, b, f"rank-merge seed={seed} keep={keep}")
+
+    def test_all_invalid_rows(self):
+        fi, fd, fq = make_frontier(3)
+        fi = fi.at[:8].set(-1)
+        fd = fd.at[:8].set(MAXU)
+        ri, rd = adversarial_responses(1003, fi)
+        ri = ri.at[:4].set(-1)                # rows with no candidates
+        a = ref_merge(fi, fd, fq, ri, rd, S)
+        b = rank_merge_round_d0(fi, fd, fq, ri, rd, S)
+        assert_bit_equal(a, b, "all-invalid rows")
+        assert bool(jnp.all(a[0][:4, :] == -1) | True)  # shape sanity
+
+    def test_duplicate_keeps_frontier_copy(self):
+        """A response naming a frontier node at a DIFFERENT d0 must be
+        dropped: the merged entry keeps the frontier copy's d0 and
+        queried flag (the queried-copy-first / first-copy-wins rule)."""
+        fi, fd, fq = make_frontier(4, evict_frac=0.0)
+        ri = jnp.where(fi[:, :1] >= 0, fi[:, :1], 0)
+        ri = jnp.concatenate(
+            [ri, jnp.full((L, C - 1), -1, jnp.int32)], axis=1)
+        rd = jnp.zeros((L, C), jnp.uint32)     # claims distance ZERO
+        out_i, out_d, out_q = rank_merge_round_d0(fi, fd, fq, ri, rd, S)
+        a = ref_merge(fi, fd, fq, ri, rd, S)
+        assert_bit_equal(a, (out_i, out_d, out_q), "frontier-copy-wins")
+        rows = np.asarray(fi[:, 0]) >= 0
+        assert np.array_equal(np.asarray(out_i)[rows],
+                              np.asarray(fi)[rows]), \
+            "a zero-claimed duplicate displaced the frontier"
+        assert np.array_equal(np.asarray(out_d)[rows],
+                              np.asarray(fd)[rows])
+
+    def test_live_sentinel_d0_candidate(self):
+        """A valid candidate whose d0 is exactly 0xFFFFFFFF ranks among
+        the all-ones group by its real index — bit-identically to the
+        sorted reference (the documented premature-exhaustion corner)."""
+        fi = jnp.full((L, S), -1, jnp.int32)
+        fd = jnp.full((L, S), MAXU)
+        fq = jnp.zeros((L, S), bool)
+        ri = jnp.full((L, C), -1, jnp.int32
+                      ).at[:, 3].set(7).at[:, 9].set(11)
+        rd = jnp.full((L, C), MAXU)
+        a = ref_merge(fi, fd, fq, ri, rd, S)
+        b = rank_merge_round_d0(fi, fd, fq, ri, rd, S)
+        assert_bit_equal(a, b, "live-sentinel")
+        assert int(a[0][0, 0]) == 7 and int(a[0][0, 1]) == 11
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_pallas_interpret_bit_equal(self, seed):
+        fi, fd, fq = make_frontier(seed)
+        ri, rd = adversarial_responses(2000 + seed, fi)
+        a = ref_merge(fi, fd, fq, ri, rd, S)
+        oi, od, oq, dn = merge_round_pallas(
+            fi, fd, fq, ri, rd, quorum=8, keep=S, interpret=True)
+        assert_bit_equal(a, (oi, od, oq), f"pallas seed={seed}")
+        # Fused quorum/exhaustion check == the engine's recomputation.
+        valid = oi[:, :8] >= 0
+        sync = jnp.all(oq[:, :8] | ~valid, axis=1) & jnp.any(valid,
+                                                             axis=1)
+        exh = ~jnp.any((oi >= 0) & ~oq, axis=1)
+        assert np.array_equal(np.asarray(dn), np.asarray(sync | exh)), \
+            "fused done contribution diverged"
+
+    def test_pallas_keep_wider_than_candidates(self):
+        fi, fd, fq = make_frontier(6)
+        ri, rd = adversarial_responses(2006, fi)
+        a = ref_merge(fi, fd, fq, ri, rd, S + C + 5)
+        oi, od, oq, _ = merge_round_pallas(
+            fi, fd, fq, ri, rd, quorum=8, keep=S + C + 5,
+            interpret=True)
+        assert_bit_equal(a, (oi, od, oq), "pallas keep>width")
+
+
+CFG_AUTO = SwarmConfig.for_nodes(2048)
+CFG_SORT = CFG_AUTO._replace(merge_impl="xla-sort")
+
+
+@pytest.fixture(scope="module")
+def churned():
+    sw = build_swarm(jax.random.PRNGKey(7), CFG_AUTO)
+    return churn(sw, jax.random.PRNGKey(9), 0.25, CFG_AUTO)
+
+
+@pytest.fixture(scope="module")
+def targets():
+    return jax.random.bits(jax.random.PRNGKey(1), (256, 5), jnp.uint32)
+
+
+def res_equal(a, b):
+    return (np.array_equal(np.asarray(a.found), np.asarray(b.found))
+            and np.array_equal(np.asarray(a.hops), np.asarray(b.hops))
+            and np.array_equal(np.asarray(a.done), np.asarray(b.done)))
+
+
+class TestEngineEquivalence:
+    def test_merge_impl_validated_and_resolved(self):
+        with pytest.raises(ValueError, match="merge_impl"):
+            SwarmConfig.for_nodes(2048, merge_impl="fancy")
+        # Off-TPU, auto must resolve to the XLA rank merge — the CPU
+        # gate never executes Pallas interpret mode on a hot path.
+        if jax.default_backend() != "tpu":
+            assert resolve_merge_impl(CFG_AUTO) == "xla"
+        assert resolve_merge_impl(CFG_SORT) == "xla-sort"
+
+    def test_plain_engines_bit_identical(self, churned, targets):
+        r_a = lookup(churned, CFG_AUTO, targets, jax.random.PRNGKey(2))
+        r_s = lookup(churned, CFG_SORT, targets, jax.random.PRNGKey(2))
+        assert res_equal(r_a, r_s)
+
+    def test_traced_engines_bit_identical(self, churned, targets):
+        r_a, t_a = traced_lookup(churned, CFG_AUTO, targets,
+                                 jax.random.PRNGKey(2))
+        r_s, t_s = traced_lookup(churned, CFG_SORT, targets,
+                                 jax.random.PRNGKey(2))
+        assert res_equal(r_a, r_s)
+        assert all(np.array_equal(np.asarray(x), np.asarray(y))
+                   for x, y in zip(t_a, t_s))
+
+    def test_chaos_engine_bit_identical(self, churned, targets):
+        bz = corrupt_swarm(churned, jax.random.PRNGKey(3), 0.10,
+                           CFG_AUTO)
+        f = LookupFaults(drop_frac=0.15, seed=6)
+        r_a, s_a = chaos_lookup(bz, CFG_AUTO, targets,
+                                jax.random.PRNGKey(4), f)
+        r_s, s_s = chaos_lookup(bz, CFG_SORT, targets,
+                                jax.random.PRNGKey(4), f)
+        assert res_equal(r_a, r_s)
+        assert np.array_equal(np.asarray(s_a), np.asarray(s_s))
+
+    def test_pallas_engine_bit_identical_small(self):
+        """The fused kernel threaded through the ACTUAL engine (tiny
+        swarm — interpret mode is slow) must reproduce the sorted path
+        bit-for-bit, results and hops included."""
+        cfg_p = SwarmConfig.for_nodes(512, merge_impl="pallas")
+        cfg_s = cfg_p._replace(merge_impl="xla-sort")
+        sw = build_swarm(jax.random.PRNGKey(0), cfg_p)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (32, 5), jnp.uint32)
+        r_p = lookup(sw, cfg_p, tg, jax.random.PRNGKey(2))
+        r_s = lookup(sw, cfg_s, tg, jax.random.PRNGKey(2))
+        assert res_equal(r_p, r_s)
+
+    def test_sharded_engine_bit_identical(self):
+        from opendht_tpu.parallel import make_mesh
+        from opendht_tpu.parallel.sharded import sharded_lookup
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        mesh = make_mesh(8)
+        cfg_a = SwarmConfig.for_nodes(8192)
+        cfg_s = cfg_a._replace(merge_impl="xla-sort")
+        sw = build_swarm(jax.random.PRNGKey(0), cfg_a)
+        sw = churn(sw, jax.random.PRNGKey(9), 0.3, cfg_a)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (2048, 5),
+                             jnp.uint32)
+        r_a = sharded_lookup(sw, cfg_a, tg, jax.random.PRNGKey(2),
+                             mesh, 2.0)
+        r_s = sharded_lookup(sw, cfg_s, tg, jax.random.PRNGKey(2),
+                             mesh, 2.0)
+        assert res_equal(r_a, r_s)

@@ -312,6 +312,33 @@ class TestCheckTrace:
         bad["bench"]["done_frac"] = 0.5                # trace disagrees
         assert any("done_frac" in e for e in check_trace_obj(bad))
 
+    def test_phase_attribution_fields(self):
+        """Round-9 fields: a consistent init/loop/finalize split and a
+        per-round p50 pass; negative phases, a sum that misses the
+        total, and a p50 exceeding the loop phase are all flagged."""
+        from opendht_tpu.tools.check_trace import check_trace_obj
+        art = self._artifact()
+        art["bench"]["phase_wall"] = {"init_s": 0.1, "loop_s": 2.0,
+                                      "finalize_s": 0.05,
+                                      "total_s": 2.15}
+        art["bench"]["round_wall_p50"] = 0.4
+        assert check_trace_obj(art) == []
+        bad = json.loads(json.dumps(art))
+        bad["bench"]["phase_wall"]["loop_s"] = -1.0
+        assert any("phase_wall" in e for e in check_trace_obj(bad))
+        bad = json.loads(json.dumps(art))
+        bad["bench"]["phase_wall"]["total_s"] = 9.0   # parts miss total
+        assert any("phase_wall" in e for e in check_trace_obj(bad))
+        bad = json.loads(json.dumps(art))
+        bad["bench"]["phase_wall"].pop("init_s")
+        assert any("phase_wall" in e for e in check_trace_obj(bad))
+        bad = json.loads(json.dumps(art))
+        bad["bench"]["round_wall_p50"] = 3.0          # > whole loop
+        assert any("round_wall_p50" in e for e in check_trace_obj(bad))
+        bad = json.loads(json.dumps(art))
+        bad["bench"]["round_wall_p50"] = 0
+        assert any("round_wall_p50" in e for e in check_trace_obj(bad))
+
     def test_chaos_artifact_headline_fallback(self):
         """chaos-lookup artifacts nest done_frac/recall under
         bench['headline'] — the cross-checks must still bind there."""
