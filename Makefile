@@ -4,7 +4,7 @@
 
 NATIVE_SRC := opendht_tpu/native/dhtcore.cpp
 
-.PHONY: all native test bench gate clean
+.PHONY: all native test bench gate profile clean
 
 all: native
 
@@ -46,14 +46,38 @@ bench:
 # runs exactly once) re-proves the straggler-harvesting ladder is
 # bit-identical to the uncompacted engines (plain, traced, chaos,
 # sharded); the dryrun asserts both on the mesh.
+# The 100k leg also runs the COST LEDGER (--ledger-out): per-kernel
+# cost attribution + the round sub-phase A/B table, validated by
+# check_trace (rows must sum to round_wall_p50 ±10%, peak HBM ≥ live,
+# compile count 0 in the clocked attribution pass) and priced by
+# roofline (compute/memory/gather-issue verdict per phase).  The
+# repub-profile leg prices one republish sweep end-to-end (per-value
+# lookup vs store-insert vs host orchestration, rows summing to the
+# sweep wall — the ROADMAP #1 artifact) and gates it the same way.
 gate: test
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	python -m pytest tests/test_merge_equivalence.py -q
-	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256 --trace-out /tmp/trace.json
+	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256 --trace-out /tmp/trace.json --ledger-out /tmp/ledger.json
 	python -m opendht_tpu.tools.check_trace /tmp/trace.json
+	python -m opendht_tpu.tools.check_trace /tmp/ledger.json
+	python -m opendht_tpu.tools.roofline /tmp/ledger.json
 	python -m opendht_tpu.tools.check_bench /tmp/trace.json BENCH_GATE_r06.json
+	python bench.py --mode repub-profile --nodes 16384 --puts 2048 --repeat 2 --ledger-out /tmp/ledger_repub.json
+	python -m opendht_tpu.tools.check_trace /tmp/ledger_repub.json
 	python bench.py --mode chaos --nodes 16384 --puts 2048
 	python bench.py --mode chaos-lookup --nodes 16384 --lookups 4096 --recall-sample 256
+
+# Profiling workflow (README "Profiling"): the gate-config cost ledger
+# with its roofline verdict, plus the small republish-sweep profile —
+# everything ROADMAP #1/#4 need before touching the round core or the
+# maintenance path again.
+profile:
+	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256 --ledger-out /tmp/ledger.json
+	python -m opendht_tpu.tools.check_trace /tmp/ledger.json
+	python -m opendht_tpu.tools.roofline /tmp/ledger.json
+	python bench.py --mode repub-profile --nodes 16384 --puts 2048 --repeat 2 --ledger-out /tmp/ledger_repub.json
+	python -m opendht_tpu.tools.check_trace /tmp/ledger_repub.json
+	python -m opendht_tpu.tools.roofline /tmp/ledger_repub.json
 
 clean:
 	rm -f opendht_tpu/native/libdhtcore-*.so

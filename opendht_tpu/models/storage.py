@@ -63,6 +63,7 @@ over the node axis exactly like the routing tables.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import NamedTuple, Tuple
 
@@ -975,7 +976,8 @@ def expire(store: SwarmStore, scfg: StoreConfig, now) -> SwarmStore:
 def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                    scfg: StoreConfig, node_idx: jax.Array, now,
                    rng: jax.Array, drop_frac: float = 0.0,
-                   drop_key: jax.Array | None = None
+                   drop_key: jax.Array | None = None,
+                   stats: dict | None = None
                    ) -> Tuple[SwarmStore, AnnounceReport]:
     """Chosen nodes re-announce every value they hold — the storage
     maintenance that restores replication after churn
@@ -988,7 +990,18 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     ``drop_frac``/``drop_key`` inject maintenance-RPC loss
     (:func:`drop_exchanges`) — the chaos harness's knob for proving
     survival degrades gracefully, not catastrophically.
+
+    ``stats`` with ``time_phases`` set splits the sweep's wall into
+    ``extract_s`` (store-row gathers → the announce batch),
+    ``lookup_s`` (the per-value lookup phase), ``insert_s`` (the
+    store-insert scatter program) and ``sweep_total_s``, with a
+    ``block_until_ready`` barrier between phases — the cost ledger's
+    repub-profile attribution (same contract as ``lookup``'s
+    ``stats["time_phases"]``: the barriers de-pipeline the device
+    queue, so attribution passes are SEPARATE from timed sweeps).
     """
+    timing = bool(stats) and stats.get("time_phases")
+    t0 = time.perf_counter() if timing else 0.0
     s = scfg.slots
     n_safe = jnp.clip(node_idx, 0, cfg.n_nodes - 1)
     ok = (node_idx >= 0)[:, None] & swarm.alive[n_safe][:, None] \
@@ -1007,12 +1020,25 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     else:
         payloads = jnp.zeros((m_rows, 0), jnp.uint32)
     okf = ok.reshape(-1)
+    if timing:
+        jax.block_until_ready((keys, vals, seqs, payloads, okf))
+        t1 = time.perf_counter()
+        stats["extract_s"] = t1 - t0
     res = lookup(swarm, cfg, keys, rng)
+    if timing:
+        jax.block_until_ready(res)
+        t2 = time.perf_counter()
+        stats["lookup_s"] = t2 - t1
     found = jnp.where(okf[:, None], res.found, -1)
     found = drop_exchanges(found, drop_frac, drop_key)
     store, replicas, trace = _announce_insert(swarm.alive, cfg, store,
                                               scfg, found, keys, vals,
                                               seqs, jnp.uint32(now),
                                               sizes, ttls, payloads)
+    if timing:
+        jax.block_until_ready((store, replicas))
+        t3 = time.perf_counter()
+        stats["insert_s"] = t3 - t2
+        stats["sweep_total_s"] = t3 - t0
     return store, AnnounceReport(replicas=replicas, hops=res.hops,
                                  done=res.done, trace=trace)

@@ -1,0 +1,19 @@
+"""Observability plane: cost attribution on top of PR 3's flight
+recorder.
+
+``opendht_tpu.obs.ledger`` extends the counters-only recorder
+(``LookupTrace``/``StoreTrace``) to *cost* attribution: per-compiled-
+executable wall/FLOPs/bytes records, HBM watermarks, and the
+round-sub-phase A/B pass that prices gather / window decode /
+alpha-select / merge / scatter-writeback against the fused round.
+``opendht_tpu.tools.roofline`` turns a ledger artifact into the
+compute- vs memory- vs issue-bound verdict.
+"""
+
+from .ledger import (  # noqa: F401
+    CostLedger,
+    hbm_watermark,
+    instrumented_entry_points,
+    measure_round_phases,
+    step_cache_size,
+)

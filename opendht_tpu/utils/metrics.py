@@ -128,6 +128,15 @@ class Histogram(Metric):
 
     DEFAULT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 
+    # Latency-shaped bounds (seconds) for wall-clock distributions —
+    # the ledger's per-invocation kernel walls and the serve-mode SLO
+    # gauges (ROADMAP #2).  The hop-shaped DEFAULT_BUCKETS above stay
+    # the default for count-like observations; pass
+    # ``buckets=Histogram.LATENCY_BUCKETS_S`` for time series.
+    LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                         0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                         60.0)
+
     def __init__(self, name: str, help: str,
                  label_names: Sequence[str] = (),
                  buckets: Optional[Iterable[float]] = None):
@@ -228,6 +237,17 @@ class MetricsRegistry:
                     raise ValueError(
                         f"metric {name!r} re-registered with a different "
                         f"type or label set")
+                # A histogram's bucket bounds are part of its contract:
+                # a second registrant asking for DIFFERENT bounds would
+                # silently observe into the first's buckets and export
+                # a distribution neither asked for.
+                want = kw.get("buckets")
+                if want is not None and isinstance(m, Histogram) \
+                        and tuple(sorted(want)) != m.buckets:
+                    raise ValueError(
+                        f"histogram {name!r} re-registered with "
+                        f"different buckets {tuple(sorted(want))} != "
+                        f"{m.buckets}")
                 return m
             m = cls(name, help, label_names, **kw)
             self._metrics[name] = m

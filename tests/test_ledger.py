@@ -1,0 +1,433 @@
+"""Cost ledger: the kernel/memory/phase planes must observe WITHOUT
+perturbing — results, strikes and traces bit-identical with the ledger
+on or off (mirroring tests/test_compaction.py's equivalence style) —
+and the artifacts they produce must satisfy (and be gated by)
+check_trace's ledger contract.
+
+Swarm geometry deliberately matches test_compaction.py (same config,
+seeds and batch width), so this module reuses the jit cache that suite
+already paid for.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.models.swarm import (
+    LookupFaults,
+    LookupTrace,
+    SwarmConfig,
+    build_swarm,
+    chaos_lookup,
+    churn,
+    corrupt_swarm,
+    lookup,
+    traced_lookup,
+)
+from opendht_tpu.obs.ledger import (
+    ENTRY_POINTS,
+    CostLedger,
+    hbm_watermark,
+    measure_round_phases,
+    step_cache_size,
+)
+from opendht_tpu.tools.check_trace import check_ledger_obj
+
+CFG = SwarmConfig.for_nodes(2048)
+L = 512
+
+
+@pytest.fixture(scope="module")
+def swarm():
+    return build_swarm(jax.random.PRNGKey(7), CFG)
+
+
+@pytest.fixture(scope="module")
+def churned(swarm):
+    return churn(swarm, jax.random.PRNGKey(9), 0.25, CFG)
+
+
+@pytest.fixture(scope="module")
+def targets():
+    return jax.random.bits(jax.random.PRNGKey(1), (L, 5), jnp.uint32)
+
+
+def _res_equal(a, b):
+    return (np.array_equal(np.asarray(a.found), np.asarray(b.found))
+            and np.array_equal(np.asarray(a.hops), np.asarray(b.hops))
+            and np.array_equal(np.asarray(a.done), np.asarray(b.done)))
+
+
+class TestPureObserver:
+    """Instrumentation wraps the jitted entry points in place; every
+    engine must produce bit-identical output under it."""
+
+    def test_plain_bit_identical(self, churned, targets):
+        r0 = lookup(churned, CFG, targets, jax.random.PRNGKey(2))
+        led = CostLedger()
+        with led.instrument(barrier=True):
+            r1 = lookup(churned, CFG, targets, jax.random.PRNGKey(2))
+        assert _res_equal(r0, r1)
+        # ...and something was actually observed.
+        assert any(r["calls"] for r in led.kernels.values())
+
+    def test_traced_bit_identical_including_trace(self, churned,
+                                                  targets):
+        r0, t0 = traced_lookup(churned, CFG, targets,
+                               jax.random.PRNGKey(2))
+        led = CostLedger()
+        with led.instrument():
+            r1, t1 = traced_lookup(churned, CFG, targets,
+                                   jax.random.PRNGKey(2))
+        assert _res_equal(r0, r1)
+        assert all(np.array_equal(np.asarray(x), np.asarray(y))
+                   for x, y in zip(t0, t1))
+
+    def test_chaos_bit_identical_including_strikes(self, churned,
+                                                   targets):
+        bz = corrupt_swarm(churned, jax.random.PRNGKey(3), 0.10, CFG)
+        f = LookupFaults(drop_frac=0.15, seed=6)
+        r0, s0 = chaos_lookup(bz, CFG, targets, jax.random.PRNGKey(4),
+                              f)
+        led = CostLedger()
+        with led.instrument():
+            r1, s1 = chaos_lookup(bz, CFG, targets,
+                                  jax.random.PRNGKey(4), f)
+        assert _res_equal(r0, r1)
+        assert np.array_equal(np.asarray(s0), np.asarray(s1))
+
+    def test_entry_points_restored_after_context(self):
+        import importlib
+        before = {}
+        for mod_name, attr, _ in ENTRY_POINTS:
+            mod = importlib.import_module(mod_name)
+            before[(mod_name, attr)] = getattr(mod, attr, None)
+        with CostLedger().instrument():
+            pass
+        for (mod_name, attr), fn in before.items():
+            mod = importlib.import_module(mod_name)
+            assert getattr(mod, attr, None) is fn, (mod_name, attr)
+
+
+class TestShardedObserver:
+    @pytest.fixture(scope="class")
+    def mesh8(self):
+        from opendht_tpu.parallel import make_mesh
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        return make_mesh(8)
+
+    def test_sharded_bit_identical(self, mesh8):
+        from opendht_tpu.parallel.sharded import sharded_lookup
+        cfg = SwarmConfig.for_nodes(8192)
+        sw = build_swarm(jax.random.PRNGKey(0), cfg)
+        sw = churn(sw, jax.random.PRNGKey(9), 0.3, cfg)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (4096, 5),
+                             jnp.uint32)
+        r0 = sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2), mesh8,
+                            2.0)
+        led = CostLedger()
+        with led.instrument():
+            r1 = sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2),
+                                mesh8, 2.0)
+        assert _res_equal(r0, r1)
+        assert any(n.startswith("sharded.") for n in led.kernels
+                   if led.kernels[n]["calls"])
+
+
+class TestKernelPlane:
+    def test_registry_names_exist(self):
+        """Every registered entry point must still exist — a rename in
+        models/parallel silently un-instruments the ledger otherwise."""
+        import importlib
+        for mod_name, attr, donate in ENTRY_POINTS:
+            mod = importlib.import_module(mod_name)
+            fn = getattr(mod, attr, None)
+            assert callable(fn), f"{mod_name}.{attr} vanished"
+            assert isinstance(donate, tuple)
+
+    def test_records_walls_donation_costs(self, churned, targets):
+        led = CostLedger()
+        with led.instrument(barrier=True):
+            lookup(churned, CFG, targets, jax.random.PRNGKey(2))
+        d = led.to_dict(bench_row={"metric": "t", "value": 1.0})
+        names = {k["name"]: k for k in d["kernels"]}
+        step = names["swarm._lookup_step_d"]
+        assert step["calls"] >= 1 and step["wall_s"] >= 0
+        assert step["donated"] and step["donate_argnums"] == [2]
+        # cost_analysis fills on this runtime (CPU backend exposes it);
+        # the artifact contract allows None but never negatives.
+        for k in d["kernels"]:
+            for field in ("flops", "bytes_accessed"):
+                assert k[field] is None or k[field] >= 0
+        assert step["compile_count"] is None or step["compile_count"] >= 1
+        # Window delta: compiles that happened INSIDE the instrumented
+        # pass (0 when the run above was pre-warmed by earlier tests).
+        assert step["compiles_in_window"] is None \
+            or 0 <= step["compiles_in_window"] <= step["compile_count"]
+
+    def test_storage_entry_points_recorded(self, swarm):
+        from opendht_tpu.models.storage import (StoreConfig, announce,
+                                                empty_store)
+        scfg = StoreConfig(slots=4, listen_slots=2, max_listeners=64,
+                           payload_words=2)
+        keys = jax.random.bits(jax.random.PRNGKey(5), (64, 5),
+                               jnp.uint32)
+        vals = jnp.arange(64, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((64,), jnp.uint32)
+        pls = jax.random.bits(jax.random.PRNGKey(6), (64, 2),
+                              jnp.uint32)
+        led = CostLedger()
+        with led.instrument():
+            store = empty_store(CFG.n_nodes, scfg)
+            store, rep = announce(swarm, CFG, store, scfg, keys, vals,
+                                  seqs, 0, jax.random.PRNGKey(8),
+                                  payloads=pls)
+            jax.block_until_ready(rep.replicas)
+        assert led.kernels["storage._announce_insert"]["calls"] >= 1
+
+    def test_step_cache_size_stable_on_replay(self, churned, targets):
+        """The bench compile-isolation invariant: replaying a seed
+        recompiles nothing (same ladder, same programs)."""
+        lookup(churned, CFG, targets, jax.random.PRNGKey(2))
+        before = step_cache_size()
+        lookup(churned, CFG, targets, jax.random.PRNGKey(2))
+        assert step_cache_size() - before == 0
+
+
+class TestMemoryPlane:
+    def test_watermark_shape_and_peak(self):
+        wm = hbm_watermark()
+        assert wm["live_bytes"] >= 0
+        assert wm["peak_bytes"] >= wm["live_bytes"]
+        assert wm["source"] in ("live_arrays", "memory_stats")
+
+    def test_ledger_tracks_peak_across_samples(self, swarm):
+        led = CostLedger()
+        base = led.hbm()["peak_bytes"]
+        big = jnp.ones((1 << 20,), jnp.uint32)   # +4 MB live
+        jax.block_until_ready(big)
+        led.sample_hbm()
+        del big
+        assert led.hbm()["peak_bytes"] >= base
+
+
+class TestRoundPhases:
+    @pytest.fixture(scope="class")
+    def phases(self, churned, targets):
+        return measure_round_phases(churned, CFG, targets,
+                                    jax.random.PRNGKey(5), repeats=2)
+
+    def test_rows_telescope_to_fused_round(self, phases):
+        names = [r["phase"] for r in phases["rows"]]
+        assert names == ["alpha-select", "gather", "window-decode",
+                         "merge", "scatter-writeback"]
+        s = sum(r["wall_s"] for r in phases["rows"])
+        # Telescoping differences: the sum IS the fused measurement
+        # (up to each row's independent 6-decimal rounding).
+        assert abs(s - phases["fused_round_wall_s"]) \
+            < 1e-6 * (len(phases["rows"]) + 1)
+        assert phases["prefix_equivalent"]
+        assert phases["lookup_step_wall_s"] > 0
+        for r in phases["rows"]:
+            for field in ("flops", "bytes_accessed"):
+                assert r[field] is None or r[field] >= 0
+
+    def test_artifact_passes_checker(self, phases):
+        led = CostLedger()
+        led.record_call("swarm._lookup_step_d", 0.01)
+        led.round_phases = dict(phases)
+        obj = led.to_dict(bench_row={
+            "metric": "swarm_lookups_per_sec", "value": 1.0,
+            "round_wall_p50": phases["fused_round_wall_s"]})
+        obj = json.loads(json.dumps(obj))    # artifact = JSON
+        assert check_ledger_obj(obj) == []
+
+    def test_checker_rejects_bad_artifacts(self, phases):
+        led = CostLedger()
+        led.record_call("k", 0.01)
+        led.round_phases = dict(phases)
+        base = json.loads(json.dumps(led.to_dict(bench_row={
+            "metric": "m", "value": 1.0,
+            "round_wall_p50": phases["fused_round_wall_s"]})))
+        # drifted sum: p50 far from the rows
+        bad = json.loads(json.dumps(base))
+        bad["bench"]["round_wall_p50"] = \
+            10 * max(phases["fused_round_wall_s"], 1e-3)
+        assert any("drift" in e for e in check_ledger_obj(bad))
+        # negative flops
+        bad = json.loads(json.dumps(base))
+        bad["round_phases"]["rows"][0]["flops"] = -1.0
+        assert any("flops" in e for e in check_ledger_obj(bad))
+        # peak < live
+        bad = json.loads(json.dumps(base))
+        bad["hbm"]["peak_bytes"] = bad["hbm"]["live_bytes"] - 1
+        assert any("peak_bytes" in e for e in check_ledger_obj(bad))
+        # a compile leaked into the clocked attribution pass
+        bad = json.loads(json.dumps(base))
+        bad["attr_compile_count"] = 2
+        assert any("compile" in e for e in check_ledger_obj(bad))
+        # nothing to gate
+        bad = json.loads(json.dumps(base))
+        del bad["round_phases"]
+        assert any("nothing to gate" in e for e in check_ledger_obj(bad))
+        # missing measured wall target: a violation, never a crash
+        bad = json.loads(json.dumps(base))
+        del bad["round_phases"]
+        bad["repub_profile"] = {"rows": [{"phase": "lookup",
+                                          "wall_s": 1.0}]}
+        assert any("sweep_wall_s missing" in e
+                   for e in check_ledger_obj(bad))
+
+
+class TestRepubProfileAndStoreTraceMerge:
+    @pytest.fixture(scope="class")
+    def stored(self, churned):
+        from opendht_tpu.models.storage import (StoreConfig, announce,
+                                                empty_store)
+        scfg = StoreConfig(slots=4, listen_slots=2, max_listeners=64,
+                           payload_words=2)
+        p = 128
+        keys = jax.random.bits(jax.random.PRNGKey(11), (p, 5),
+                               jnp.uint32)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((p,), jnp.uint32)
+        pls = jax.random.bits(jax.random.PRNGKey(12), (p, 2),
+                              jnp.uint32)
+        store = empty_store(CFG.n_nodes, scfg)
+        store, _ = announce(churned, CFG, store, scfg, keys, vals,
+                            seqs, 0, jax.random.PRNGKey(13),
+                            payloads=pls)
+        return scfg, store, keys, vals
+
+    def test_republish_phase_stats(self, churned, stored):
+        from opendht_tpu.models.storage import republish_from
+        scfg, store, _, _ = stored
+        all_idx = jnp.arange(CFG.n_nodes, dtype=jnp.int32)
+        stats = {"time_phases": True}
+        _, rep = republish_from(churned, CFG, store, scfg, all_idx, 1,
+                                jax.random.PRNGKey(14), stats=stats)
+        jax.block_until_ready(rep.replicas)
+        for f in ("extract_s", "lookup_s", "insert_s",
+                  "sweep_total_s"):
+            assert stats[f] >= 0, f
+        parts = (stats["extract_s"] + stats["lookup_s"]
+                 + stats["insert_s"])
+        # Phases are nested sub-intervals of the total.
+        assert parts <= stats["sweep_total_s"] + 1e-9
+
+    def test_store_trace_merge_across_republish_chunks(self, churned,
+                                                       stored):
+        """Chunked maintenance (the bench's memory-bounded sweeps):
+        per-chunk StoreTraces merge by field-wise sum, each chunk's
+        counters satisfy the accept/reject accounting, and the chunked
+        sweep restores replication like a whole-swarm one."""
+        from opendht_tpu.models.storage import (get_values,
+                                                republish_from)
+        scfg, store, keys, vals = stored
+        n = CFG.n_nodes
+        half = n // 2
+        idx = jnp.arange(n, dtype=jnp.int32)
+        traces = []
+        st = store
+        for i, chunk in enumerate((idx[:half], idx[half:])):
+            st, rep = republish_from(churned, CFG, st, scfg, chunk,
+                                     2 + i, jax.random.PRNGKey(20 + i))
+            traces.append(rep.trace)
+        merged = traces[0] + traces[1]
+        md = merged.to_dict()
+        for name in md:
+            assert md[name] == sum(int(getattr(t, name))
+                                   for t in traces), name
+        for t in traces:
+            d = t.to_dict()
+            assert d["accepts_update"] + d["accepts_new"] \
+                + d["rejects"] <= d["requests"]
+        assert md["requests"] > 0
+        # Chunked maintenance keeps the values retrievable.
+        res = get_values(churned, CFG, st, scfg, keys,
+                         jax.random.PRNGKey(30))
+        hit = float(np.asarray(res.hit).mean())
+        assert hit >= 0.9, hit
+        ok = np.asarray(jnp.where(res.hit, res.val == vals, True))
+        assert ok.all()
+
+
+class TestRooflineReport:
+    def test_classification_and_report(self):
+        from opendht_tpu.tools.roofline import classify, roofline_report
+        # Achieved ≈ the compute roof → compute-bound.
+        c = classify(1.0, 150e9, 1e6, 200.0, 80.0)
+        assert c["bound"] == "compute"
+        # Achieved ≈ the memory roof → memory-bound.
+        m = classify(1.0, 1e6, 60e9, 200.0, 80.0)
+        assert m["bound"] == "memory"
+        # Far below both roofs → gather/issue-bound.
+        g = classify(1.0, 1e9, 1e9, 200.0, 80.0)
+        assert g["bound"] == "gather-issue"
+        assert classify(0.0, 1.0, 1.0, 200.0, 80.0)["bound"] \
+            == "unmeasured"
+
+        ledger = {
+            "kind": "cost_ledger", "platform": "cpu",
+            "bench": {"round_wall_p50": 1.0},
+            "hbm": {"live_bytes": 1, "peak_bytes": 1,
+                    "source": "live_arrays"},
+            "kernels": [{"name": "k", "calls": 2, "wall_s": 2.0,
+                         "flops": 1e9, "bytes_accessed": 1e9,
+                         "donated": True}],
+            "round_phases": {"prefix_equivalent": True, "rows": [
+                {"phase": "a", "wall_s": 0.5, "flops": 1e9,
+                 "bytes_accessed": 1e6},
+                {"phase": "b", "wall_s": 0.5, "flops": 1e6,
+                 "bytes_accessed": 2e10}]},
+        }
+        rep = roofline_report(ledger)
+        assert rep["errors"] == []
+        assert [r["bound"] for r in rep["round_phases"]] \
+            == ["gather-issue", "memory"]
+        # Rows that can't reproduce the measured round are an error.
+        ledger["bench"]["round_wall_p50"] = 5.0
+        assert roofline_report(ledger)["errors"]
+
+    def test_main_on_file(self, tmp_path, capsys):
+        from opendht_tpu.tools.roofline import main
+        path = tmp_path / "ledger.json"
+        path.write_text(json.dumps({
+            "kind": "cost_ledger", "platform": "cpu",
+            "hbm": {"live_bytes": 0, "peak_bytes": 0,
+                    "source": "live_arrays"},
+            "kernels": [{"name": "k", "calls": 1, "wall_s": 1.0,
+                         "flops": 1e6, "bytes_accessed": 1e6}],
+            "repub_profile": {"rows": [
+                {"phase": "lookup", "wall_s": 1.0}],
+                "sweep_wall_s": 1.0},
+        }))
+        assert main([str(path)]) == 0
+        assert "Republish sweep phases" in capsys.readouterr().out
+        assert main([str(tmp_path / "missing.json")]) == 1
+
+
+class TestLedgerPrometheusExport:
+    def test_export_into_registry(self, churned, targets):
+        from opendht_tpu.utils.metrics import MetricsRegistry
+        led = CostLedger()
+        with led.instrument():
+            lookup(churned, CFG, targets, jax.random.PRNGKey(2))
+        led.round_phases = {"rows": [{"phase": "merge",
+                                      "wall_s": 0.5}]}
+        reg = MetricsRegistry()
+        led.export_metrics(reg)
+        text = reg.render_prometheus()
+        assert "dht_ledger_kernel_wall_seconds" in text
+        assert 'kernel="swarm._lookup_step_d"' in text
+        assert "dht_ledger_hbm_peak_bytes" in text
+        assert 'dht_ledger_round_phase_wall_seconds{phase="merge"} 0.5' \
+            in text
+        # Invocation walls land in the latency-bucketed histogram.
+        assert 'dht_ledger_invocation_seconds_bucket' in text
+        assert 'le="0.001"' in text

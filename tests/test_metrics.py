@@ -62,6 +62,31 @@ class TestRegistry:
         assert counts == [1, 3, 3, 4]     # cumulative + inf
         assert n == 4 and total == 26.5
 
+    def test_histogram_latency_buckets_and_reregister_contract(self):
+        """Round-10 satellite: configurable bucket bounds with a
+        latency-shaped preset (the ledger's wall distributions and the
+        future serve-mode SLO gauges), and the bucket bounds are part
+        of the re-registration contract — a second registrant asking
+        for different bounds must fail loudly, not silently observe
+        into someone else's buckets."""
+        bs = Histogram.LATENCY_BUCKETS_S
+        assert bs == tuple(sorted(bs)) and bs[0] <= 0.001 and \
+            bs[-1] >= 30.0
+        reg = MetricsRegistry()
+        h = reg.histogram("wall_seconds", "w", buckets=bs)
+        h.observe(0.0004)
+        h.observe(0.3)
+        h.observe(120.0)        # over the top bound → +Inf only
+        [(_, (counts, total, n))] = h.snapshot()
+        assert counts[0] == 1 and counts[-1] == 3 and n == 3
+        assert counts[bs.index(0.5)] == 2
+        # Same/unspecified buckets → the shared instance; different →
+        # ValueError.
+        assert reg.histogram("wall_seconds", "w") is h
+        assert reg.histogram("wall_seconds", "w", buckets=bs) is h
+        with pytest.raises(ValueError):
+            reg.histogram("wall_seconds", "w", buckets=(1.0, 2.0))
+
     def test_histogram_observe_bulk_matches_pointwise(self):
         reg = MetricsRegistry()
         a = reg.histogram("a", buckets=(2, 8))
@@ -88,6 +113,10 @@ class TestPrometheusExposition:
         h = reg.histogram("dht_hops", "Lookup hops", buckets=(1, 2))
         h.observe(1)
         h.observe(3)
+        lat = reg.histogram("dht_wall_seconds", "Ledger walls",
+                            buckets=(0.25, 2.5))
+        lat.observe(0.25)
+        lat.observe(0.5)
         want = (
             "# HELP dht_hops Lookup hops\n"
             "# TYPE dht_hops histogram\n"
@@ -103,6 +132,13 @@ class TestPrometheusExposition:
             "# HELP dht_nodes Nodes\n"
             "# TYPE dht_nodes gauge\n"
             "dht_nodes 7\n"
+            "# HELP dht_wall_seconds Ledger walls\n"
+            "# TYPE dht_wall_seconds histogram\n"
+            'dht_wall_seconds_bucket{le="0.25"} 1\n'
+            'dht_wall_seconds_bucket{le="2.5"} 2\n'
+            'dht_wall_seconds_bucket{le="+Inf"} 2\n'
+            "dht_wall_seconds_sum 0.75\n"
+            "dht_wall_seconds_count 2\n"
         )
         assert reg.render_prometheus() == want
 
