@@ -54,6 +54,12 @@ bench:
 # repub-profile leg prices one republish sweep end-to-end (per-value
 # lookup vs store-insert vs host orchestration, rows summing to the
 # sweep wall — the ROADMAP #1 artifact) and gates it the same way.
+# The SERVE leg (round 11) runs a short open-loop Poisson/Zipf stream
+# through the slot-recycled serve engine: check_trace validates the
+# artifact's lifecycle conservation (admitted == completed +
+# in-flight), histogram⇄row consistency and bucket-derived quantiles;
+# check_bench gates sustained req/s (0.95x floor) and tail latency
+# (1.5x p99 ceiling) against the recorded BENCH_GATE_r07.json row.
 gate: test
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	python -m pytest tests/test_merge_equivalence.py -q
@@ -64,6 +70,9 @@ gate: test
 	python -m opendht_tpu.tools.check_bench /tmp/trace.json BENCH_GATE_r06.json
 	python bench.py --mode repub-profile --nodes 16384 --puts 2048 --repeat 2 --ledger-out /tmp/ledger_repub.json
 	python -m opendht_tpu.tools.check_trace /tmp/ledger_repub.json
+	python bench.py --mode serve --nodes 16384 --arrival-rate 2000 --duration 3 --serve-slots 1024 --key-pool 1024 --serve-out /tmp/serve.json
+	python -m opendht_tpu.tools.check_trace /tmp/serve.json
+	python -m opendht_tpu.tools.check_bench /tmp/serve.json BENCH_GATE_r07.json
 	python bench.py --mode chaos --nodes 16384 --puts 2048
 	python bench.py --mode chaos-lookup --nodes 16384 --lookups 4096 --recall-sample 256
 

@@ -111,7 +111,7 @@ def main():
     ap.add_argument("--mode",
                     choices=("lookups", "putget", "churn", "crawl",
                              "sharded", "hotshard", "repub", "chaos",
-                             "chaos-lookup", "repub-profile"),
+                             "chaos-lookup", "repub-profile", "serve"),
                     default="lookups")
     ap.add_argument("--kill-frac", type=float, default=None,
                     help="fraction of nodes killed (churn/chaos: 0.5; "
@@ -129,10 +129,12 @@ def main():
                     help="chaos-lookup mode: Byzantine poison shape — "
                          "random node ids claimed near-zero, or "
                          "colluder-promotion eclipse")
-    ap.add_argument("--zipf", type=float, default=0.0,
+    ap.add_argument("--zipf", type=float, default=None,
                     help="churn mode: draw gets Zipf(s)-skewed over "
-                         "the put keyset (0 = uniform, one get/key); "
-                         "hotshard mode: target skew (default 1.2)")
+                         "the put keyset (0 = uniform, one get/key; "
+                         "default 0); hotshard mode: target skew "
+                         "(default 1.2); serve mode: request-key "
+                         "popularity (0 = uniform, default 1.1)")
     ap.add_argument("--shards", type=int, default=8,
                     help="hotshard mode: logical owner shards")
     ap.add_argument("--slots", type=int, default=0,
@@ -173,6 +175,36 @@ def main():
                          "(local bursts → shard_map/while_loop "
                          "structure → routing machinery → capacity "
                          "rule) on a 1-device mesh")
+    ap.add_argument("--track-lifecycle", action="store_true",
+                    help="lookups mode: run with the per-request "
+                         "lifecycle plane ON (admitted/completed round "
+                         "per row) — the A/B knob behind the <=5% "
+                         "tracking-overhead budget")
+    ap.add_argument("--arrival-rate", type=float, default=2000.0,
+                    help="serve mode: open-loop Poisson arrival rate "
+                         "(req/s)")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="serve mode: arrival-schedule horizon in "
+                         "seconds (capped at 120 s so a serve leg can "
+                         "never eat the tier-1 gate timeout)")
+    ap.add_argument("--serve-slots", type=int, default=2048,
+                    help="serve mode: resident lookup slots (finished "
+                         "rows' slots admit NEW requests mid-flight)")
+    ap.add_argument("--key-pool", type=int, default=4096,
+                    help="serve mode: distinct-key universe the "
+                         "Zipf-popular request keys draw from")
+    ap.add_argument("--serve-burst", type=int, default=2,
+                    help="serve mode: rounds dispatched between "
+                         "admission/harvest syncs")
+    ap.add_argument("--slo-ms", type=float, default=250.0,
+                    help="serve mode: per-request latency SLO target "
+                         "for the gauge set (milliseconds)")
+    ap.add_argument("--serve-out", metavar="FILE", default=None,
+                    help="serve mode: dump the serve artifact "
+                         "(lifecycle conservation, latency histogram + "
+                         "bucket-derived quantiles, SLO gauges) as "
+                         "JSON — validated by tools/check_trace.py, "
+                         "gated by tools/check_bench.py")
     args = ap.parse_args()
 
     # Fault fractions are probabilities: reject out-of-range values
@@ -186,6 +218,37 @@ def main():
             ap.error(f"--{frac_name.replace('_', '-')} must be a "
                      f"fraction in [0, 1], got {v}")
 
+    if args.mode == "serve":
+        # Serve-arg validation at the CLI boundary (the satellite
+        # contract): rates/durations are physical quantities — a ≤0
+        # value or an uncapped duration must fail HERE, loudly, not as
+        # a shape crash or a gate-timeout three layers down.
+        if args.arrival_rate <= 0:
+            ap.error(f"--arrival-rate must be > 0 req/s, got "
+                     f"{args.arrival_rate}")
+        if args.duration <= 0:
+            ap.error(f"--duration must be > 0 s, got {args.duration}")
+        if args.duration > 120:
+            ap.error(f"--duration {args.duration}s exceeds the 120 s "
+                     f"serve cap (the tier-1 gate runs under a 870 s "
+                     f"timeout; a longer open-loop run cannot fit a "
+                     f"gate leg — split it into repeats)")
+        if args.serve_slots < 8:
+            ap.error(f"--serve-slots must be >= 8, got "
+                     f"{args.serve_slots}")
+        if args.key_pool < 1:
+            ap.error(f"--key-pool must be >= 1, got {args.key_pool}")
+        if args.serve_burst < 1:
+            ap.error(f"--serve-burst must be >= 1, got "
+                     f"{args.serve_burst}")
+        if args.slo_ms <= 0:
+            ap.error(f"--slo-ms must be > 0, got {args.slo_ms}")
+        if args.zipf is not None and args.zipf < 0:
+            ap.error(f"--zipf must be >= 0, got {args.zipf}")
+    if args.zipf is None and args.mode != "serve":
+        # Non-serve modes keep their historical default (uniform for
+        # churn, the 1.2 hotshard fallback keys off 0).
+        args.zipf = 0.0
     if args.kill_frac is None:
         args.kill_frac = {"chaos-lookup": 0.10}.get(args.mode, 0.5)
     if args.nodes is None:
@@ -194,6 +257,7 @@ def main():
                       "repub": 65_536,
                       "chaos": 65_536,
                       "repub-profile": 65_536,
+                      "serve": 65_536,
                       "chaos-lookup": 1_000_000}.get(args.mode,
                                                      10_000_000)
     if args.ledger_out and args.mode == "lookups" \
@@ -203,6 +267,8 @@ def main():
         # clocks produce.
         ap.error("--ledger-out requires the compacted dispatcher in "
                  "lookups mode (drop --compact off)")
+    if args.mode == "serve":
+        return serve_main(args)
     if args.mode == "chaos-lookup":
         return chaos_lookup_main(args)
     if args.mode == "repub-profile":
@@ -259,6 +325,9 @@ def main():
     # cost, keeping the <=5% overhead budget honest.
     use_trace = bool(args.trace_out)
     compact = args.compact != "off"
+    # Lifecycle A/B knob: the tracked engine must stay bit-identical
+    # (tests) and within the <=5% budget on this leg (BASELINE.md).
+    track = bool(args.track_lifecycle)
     traces = []
     chunk_stats = []
 
@@ -268,13 +337,15 @@ def main():
         if use_trace:
             pairs = [traced_lookup(swarm, cfg, c,
                                    jax.random.PRNGKey(seed + i),
-                                   compact=compact, stats=sd(i))
+                                   compact=compact, stats=sd(i),
+                                   track_lifecycle=track)
                      for i, c in enumerate(chunks)]
             rs = [p[0] for p in pairs]
             traces[:] = [p[1] for p in pairs]
         else:
             rs = [lookup(swarm, cfg, c, jax.random.PRNGKey(seed + i),
-                         compact=compact, stats=sd(i))
+                         compact=compact, stats=sd(i),
+                         track_lifecycle=track)
                   for i, c in enumerate(chunks)]
         for r in rs:
             sync(r)
@@ -325,12 +396,14 @@ def main():
         if use_trace:
             rs = [traced_lookup(swarm, cfg, c,
                                 jax.random.PRNGKey(attr_seed + i),
-                                compact=True, stats=pstats[i])[0]
+                                compact=True, stats=pstats[i],
+                                track_lifecycle=track)[0]
                   for i, c in enumerate(chunks)]
         else:
             rs = [lookup(swarm, cfg, c,
                          jax.random.PRNGKey(attr_seed + i),
-                         compact=True, stats=pstats[i])
+                         compact=True, stats=pstats[i],
+                         track_lifecycle=track)
                   for i, c in enumerate(chunks)]
         for r in rs:
             sync(r)
@@ -413,12 +486,13 @@ def main():
             if use_trace:
                 rs = [traced_lookup(swarm, cfg_x, c,
                                     jax.random.PRNGKey(seed + i),
-                                    compact=compact)[0]
+                                    compact=compact,
+                                    track_lifecycle=track)[0]
                       for i, c in enumerate(chunks)]
             else:
                 rs = [lookup(swarm, cfg_x, c,
                              jax.random.PRNGKey(seed + i),
-                             compact=compact)
+                             compact=compact, track_lifecycle=track)
                       for i, c in enumerate(chunks)]
             for r in rs:
                 sync(r)
@@ -471,6 +545,7 @@ def main():
         "recall_at_8": round(recall, 4) if recall is not None else None,
         "compact": compact,
         "merge_impl": merge_impl,
+        "track_lifecycle": track,
         "platform": jax.devices()[0].platform,
     }
     if phase is not None:
@@ -1518,6 +1593,174 @@ def chaos_main(args):
         "sim_fidelity": "payload-chunks",
         "platform": jax.devices()[0].platform,
     }
+    print(json.dumps(out))
+
+
+def serve_main(args):
+    """Open-loop serve: the per-request latency plane (ROADMAP #2).
+
+    Poisson(``--arrival-rate``) arrivals over ``--duration`` seconds
+    with Zipf(``--zipf``)-popular keys are admitted as micro-batches
+    into recycled slots of the resident serve engine
+    (models/serve.py): finished rows' slots admit NEW requests
+    mid-flight instead of compacting away.  The reported number is not
+    throughput but the arrival→completion latency DISTRIBUTION —
+    p50/p95/p99/p99.9 derived from the latency histogram's bucket
+    bounds (``utils.metrics.Histogram.quantile``) — plus sustained
+    req/s, queue depth and slot occupancy, with the SLO gauge set
+    (target / violation ratio / error-budget burn rate) published
+    through the PR-3 Prometheus registry.  The reference sheds this
+    exact workload at 1,600 req/s global inbound
+    (include/opendht/network_engine.h:462) — vs_baseline divides by
+    that cap.  ``--serve-out`` dumps the artifact
+    ``tools/check_trace.py`` validates (lifecycle conservation,
+    histogram⇄row consistency, quantiles inside their buckets).
+    """
+    from opendht_tpu.models.serve import (
+        ServeEngine, ServeOverloadError, poisson_zipf_events,
+        serve_open_loop,
+    )
+    from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+    from opendht_tpu.obs.latency import (LatencyPlane,
+                                         publish_hop_histogram)
+    from opendht_tpu.utils.metrics import Histogram, MetricsRegistry
+
+    kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    kw["merge_impl"] = args.merge_impl
+    cfg = SwarmConfig.for_nodes(args.nodes, **kw)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    _ = np.asarray(swarm.tables[:1, :1])
+
+    # None = flag untouched → the serve default (1.1); an EXPLICIT
+    # --zipf 0 means uniform keys, exactly as poisson_zipf_events
+    # documents — never silently overridden.
+    zipf_s = 1.1 if args.zipf is None else args.zipf
+    ts, keys, klass = poisson_zipf_events(
+        rate=args.arrival_rate, duration=args.duration,
+        key_pool=args.key_pool, zipf_s=zipf_s, seed=7)
+    engine = ServeEngine(swarm, cfg, slots=args.serve_slots)
+    try:
+        rep = serve_open_loop(engine, ts, keys, jax.random.PRNGKey(3),
+                              klass=klass, burst=args.serve_burst,
+                              duration=args.duration)
+    except ServeOverloadError as e:
+        print(f"bench: {e}", file=sys.stderr)
+        sys.exit(2)
+
+    lat = rep["latency_s"]
+    slo_s = args.slo_ms / 1e3
+    registry = MetricsRegistry()
+    plane = LatencyPlane(registry, prefix="dht_serve_request",
+                         label_names=("klass",), slo_target_s=slo_s)
+    for s, k in zip(lat, rep["klass"]):
+        plane.observe(float(s), klass=str(k))
+    publish_hop_histogram(
+        registry, np.bincount(np.clip(rep["hops"], 0, cfg.max_steps),
+                              minlength=cfg.max_steps + 1))
+    # Artifact histogram: one UNlabelled latency distribution (the
+    # checker's count-conservation target), Prometheus latency bounds.
+    bounds = list(Histogram.LATENCY_BUCKETS_S)
+    bidx = np.searchsorted(bounds, lat, side="left") if len(lat) \
+        else np.zeros((0,), np.int64)
+    counts = np.bincount(bidx, minlength=len(bounds) + 1)
+    # Headline quantiles DERIVED FROM THE BUCKET BOUNDS (linear
+    # interpolation inside the holding bucket — Histogram.quantile):
+    # the artifact's histogram can always reproduce them, which is
+    # exactly what check_trace gates.  Raw-sample percentiles ride
+    # along for reference.
+    agg = Histogram("serve_latency_agg", "", buckets=bounds)
+    agg.observe_bulk([int(c) for c in counts], float(lat.sum()))
+    # None (JSON null), never NaN, with zero completions: json.dumps
+    # would happily emit the literal NaN, which is not JSON.
+    quants = {name: (round(agg.quantile(q), 6) if len(lat) else None)
+              for name, q in (("p50", 0.50), ("p95", 0.95),
+                              ("p99", 0.99), ("p999", 0.999))}
+    raw = {f"{name}_raw": (round(float(np.percentile(lat, 100 * q)), 6)
+                           if len(lat) else None)
+           for name, q in (("p50", 0.50), ("p95", 0.95),
+                           ("p99", 0.99), ("p999", 0.999))}
+    offered = rep["admitted"] + rep["never_admitted"]
+
+    out = {
+        "metric": "swarm_serve_req_per_sec",
+        "value": round(rep["sustained_rps"], 1),
+        "unit": "req/s",
+        # The reference's global inbound rate limiter caps the stream
+        # this mode models at 1,600 req/s (network_engine.h:462).
+        "vs_baseline": round(rep["sustained_rps"] / 1600.0, 2),
+        "baseline_note": "vs the reference's 1600 req/s global inbound "
+                         "rate cap (include/opendht/network_engine.h:"
+                         "462)",
+        "n_nodes": args.nodes,
+        "arrival_rate": args.arrival_rate,
+        "duration_s": args.duration,
+        "elapsed_s": round(rep["elapsed_s"], 4),
+        "serve_slots": rep["slots"],
+        "admit_cap": rep["admit_cap"],
+        "burst": rep["burst"],
+        "rounds": rep["rounds"],
+        "admitted": rep["admitted"],
+        "completed": rep["completed"],
+        "expired": rep["expired"],
+        "in_flight": rep["in_flight"],
+        "done_frac": round(rep["completed"] / offered, 6)
+        if offered else 0.0,
+        "found_nonempty_frac": round(
+            float(rep["found_nonempty"].mean()), 4)
+        if rep["completed"] else None,
+        "median_hops": float(np.median(rep["hops"]))
+        if rep["completed"] else None,
+        "latency_p50_s": quants["p50"],
+        "latency_p95_s": quants["p95"],
+        "latency_p99_s": quants["p99"],
+        "latency_p999_s": quants["p999"],
+        "latency_mean_s": round(float(lat.mean()), 6)
+        if len(lat) else None,
+        **{f"latency_{k}_s": v for k, v in raw.items()},
+        "queue_depth_mean": round(rep["queue_depth_mean"], 2),
+        "queue_depth_max": rep["queue_depth_max"],
+        "slot_occupancy_frac": round(rep["slot_occupancy_frac"], 4),
+        "slo_target_s": slo_s,
+        "slo_violation_ratio": round(plane.violation_ratio, 6),
+        "slo_error_budget_burn_rate": round(plane.burn_rate, 3),
+        "zipf_s": zipf_s,
+        "key_pool": args.key_pool,
+        "platform": jax.devices()[0].platform,
+    }
+    if args.serve_out:
+        per_class = {}
+        for cls in sorted(set(map(str, rep["klass"]))):
+            m = rep["klass"] == cls
+            per_class[cls] = {
+                "count": int(m.sum()),
+                "p50_s": round(plane.quantile(0.50, klass=cls), 6),
+                "p99_s": round(plane.quantile(0.99, klass=cls), 6),
+            }
+        obj = {
+            "kind": "swarm_serve_trace",
+            "bench": out,
+            "lifecycle": {
+                "admitted": rep["admitted"],
+                "completed": rep["completed"],
+                "expired": rep["expired"],
+                "in_flight": rep["in_flight"],
+                "never_admitted": rep["never_admitted"],
+            },
+            "latency_histogram": {
+                "bounds": bounds,
+                "counts": [int(c) for c in counts],
+                "sum": round(float(lat.sum()), 6),
+                "count": int(len(lat)),
+            },
+            "latency_quantiles_s": quants,
+            "per_class": per_class,
+            "burst_marks": [[int(r), round(w, 6)]
+                            for r, w in rep["burst_marks"]],
+            "metrics_prometheus": registry.render_prometheus(),
+        }
+        with open(args.serve_out, "w") as f:
+            json.dump(obj, f)
+            f.write("\n")
     print(json.dumps(out))
 
 

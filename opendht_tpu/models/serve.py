@@ -1,0 +1,515 @@
+"""Open-loop serving engine: slot-recycled continuous lookups.
+
+Everything before this module is closed-loop batch — ``L`` lookups in,
+one wall number out.  A production DHT front-end instead serves a
+CONTINUOUS arrival stream (the reference rate-limits exactly such a
+stream at 1,600 req/s global inbound,
+include/opendht/network_engine.h:462), and the number it lives on is
+not throughput but the per-request arrival→completion latency
+distribution under that stream (the distribution-fidelity methodology
+of arXiv:1307.7000, applied to latency instead of hop counts).
+
+The engine keeps a fixed ``[C]``-slot :class:`LookupState` resident on
+device.  A FREE slot is ``done=True`` with an empty shortlist — inert
+inside the shared round step (done rows solicit nobody), so occupancy
+is a pure cost knob, not a semantics one.  Each host-loop iteration:
+
+* **admit** — queued requests (arrived per their open-loop timestamps)
+  are scattered into free slots as one fixed-width micro-batch
+  (``admit_cap``, padded with dropped sentinel slots): the seed
+  exchange is :func:`~opendht_tpu.models.swarm.init_impl`, exactly the
+  batch engine's, and ``admitted_round`` is stamped with the current
+  round index;
+* **burst** — a few rounds of the UNMODIFIED donated step
+  (``_lookup_step_d`` / the routed ``_sharded_lookup_step``) advance
+  every occupied slot in lock-step; finished rows freeze and their
+  ``completed_round`` is stamped by ``_merge_round``'s lifecycle plane;
+* **harvest** — the one per-burst readback (the same sync cadence the
+  batch burst loop already pays) returns done/hops/lifecycle/found;
+  newly-done slots are recorded and recycled for the next admission —
+  finished rows' slots admit NEW requests mid-flight instead of
+  compacting away (the serve twin of PR 4's active-set ladder).
+
+Latency is reconstructed, not per-row-probed: the device holds round
+indices, the host holds per-burst wall clocks, and
+``arrival→completion = round-end wall(completed_round) − arrival_ts``
+with round-end walls linearly interpolated inside each burst (floored
+at the admission wall, so queueing delay is included and latency can
+never go negative on a sub-burst completion).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.xor_metric import N_LIMBS
+from . import swarm as _swarm
+from .swarm import (
+    UINT32_MAX,
+    LookupResult,
+    LookupState,
+    Swarm,
+    SwarmConfig,
+    _finalize,
+    _local_respond,
+    _sample_origins,
+    burst_schedule,
+    init_impl,
+)
+
+
+class ServeOverloadError(RuntimeError):
+    """The open-loop arrival stream exceeds what the slot capacity can
+    drain: the admission queue grew past the overload bound.  Raised
+    with a clear message instead of letting the queue (and the run)
+    grow without bound — the serve bench surfaces it as a CLI error."""
+
+
+@partial(jax.jit, static_argnames=("cfg", "slots"))
+def empty_serve_state(cfg: SwarmConfig, slots: int) -> LookupState:
+    """All-free ``[slots]`` serve state: every row done with an empty
+    shortlist (inert in the round step) and lifecycle ``-1``/``-1``
+    (never admitted)."""
+    s = cfg.search_width
+    return LookupState(
+        targets=jnp.zeros((slots, N_LIMBS), jnp.uint32),
+        idx=jnp.full((slots, s), -1, jnp.int32),
+        dist=jnp.full((slots, s), UINT32_MAX, jnp.uint32),
+        queried=jnp.zeros((slots, s), bool),
+        done=jnp.ones((slots,), bool),
+        hops=jnp.zeros((slots,), jnp.int32),
+        admitted_round=jnp.full((slots,), -1, jnp.int32),
+        completed_round=jnp.full((slots,), -1, jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _admit(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+           keys: jax.Array, slots: jax.Array, origins: jax.Array,
+           rnd: jax.Array) -> LookupState:
+    """Scatter a padded admission micro-batch into free slots.
+
+    ``keys [A,5]``; ``slots [A]`` target slot per request with the
+    PAD SENTINEL ``C`` (= the slot count — ``mode="drop"`` makes padded
+    rows vanish); ``origins [A]`` issuing nodes.  The seed exchange is
+    the batch engine's ``init_impl`` verbatim, so a closed-loop replay
+    through this path is bit-identical to ``lookup`` (tests).  The
+    state is DONATED: the serve carry is single-owner, like the burst
+    loops'.
+    """
+    new = init_impl(swarm.ids, _local_respond(swarm, cfg), cfg, keys,
+                    origins)
+    return _scatter_rows_into(st, new, slots, rnd)
+
+
+def _scatter_rows_into(st: LookupState, new: LookupState,
+                       slots: jax.Array, rnd) -> LookupState:
+    """ONE copy of the admission scatter (slot sentinel = slot count,
+    dropped), shared by the local and sharded admit programs — a new
+    ``LookupState`` field lands in both or in neither."""
+    sl = slots
+    return LookupState(
+        targets=st.targets.at[sl].set(new.targets, mode="drop"),
+        idx=st.idx.at[sl].set(new.idx, mode="drop"),
+        dist=st.dist.at[sl].set(new.dist, mode="drop"),
+        queried=st.queried.at[sl].set(new.queried, mode="drop"),
+        done=st.done.at[sl].set(False, mode="drop"),
+        hops=st.hops.at[sl].set(0, mode="drop"),
+        admitted_round=st.admitted_round.at[sl].set(
+            jnp.asarray(rnd, jnp.int32), mode="drop"),
+        completed_round=st.completed_round.at[sl].set(-1, mode="drop"))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _snapshot(swarm: Swarm, cfg: SwarmConfig, st: LookupState):
+    """The per-burst harvest readback: done mask, hops, lifecycle rows
+    and the finalized result heads — one ``device_get`` of small
+    arrays, the serve loop's only host sync."""
+    return (st.done, st.hops, st.admitted_round, st.completed_round,
+            _finalize(swarm.ids, st, cfg))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _expire_slots(st: LookupState, slots: jax.Array) -> LookupState:
+    """Retire rows that exceeded their round budget: mark them done so
+    the step stops soliciting and the slot can recycle.
+    ``completed_round`` stays -1 — an expired request never completed,
+    and the host books it as ``expired``, not as a latency sample.
+    The serve twin of the batch engine's ``max_steps`` cap (which
+    reports stragglers as ``done=False`` instead of spinning forever);
+    without it a non-converging lookup would hold its slot for the
+    whole run and a sustainable arrival rate could still starve into a
+    misleading overload error."""
+    return st._replace(done=st.done.at[slots].set(True, mode="drop"))
+
+
+class ServeEngine:
+    """Single-chip serve engine: admit / step / snapshot over one
+    resident ``[slots]`` state.  ``admit_cap`` fixes the admission
+    micro-batch width (one compiled admit program)."""
+
+    def __init__(self, swarm: Swarm, cfg: SwarmConfig, slots: int,
+                 admit_cap: int | None = None):
+        self.swarm, self.cfg, self.slots = swarm, cfg, slots
+        self.admit_cap = min(slots, admit_cap or min(slots, 512))
+
+    def empty(self) -> LookupState:
+        return empty_serve_state(self.cfg, self.slots)
+
+    def admit(self, st, keys, slots, key, rnd):
+        # Origin draw with the caller's key DIRECTLY (no folding): the
+        # closed-loop replay relies on this matching the batch engine's
+        # ``_sample_origins(key, alive, l)`` bit-for-bit.
+        origins = _sample_origins(key, self.swarm.alive,
+                                  keys.shape[0])
+        return _admit(self.swarm, self.cfg, st, keys, slots, origins,
+                      jnp.int32(rnd))
+
+    def step(self, st, rnd):
+        # Resolved through the module attribute so the cost ledger's
+        # in-place instrumentation (obs/ledger.py ENTRY_POINTS) sees
+        # serve rounds like burst-loop rounds.
+        return _swarm._lookup_step_d(self.swarm, self.cfg, st,
+                                     jnp.int32(rnd))
+
+    def expire(self, st, slots):
+        return _expire_slots(st, slots)
+
+    def snapshot(self, st):
+        return jax.device_get(_snapshot(self.swarm, self.cfg, st))
+
+
+class ShardedServeEngine(ServeEngine):
+    """Mesh serve engine: the routed ``_sharded_lookup_step`` advances
+    the resident state; admission seeds through the routed init (shard-
+    local origin sampling) and scatters into the global slot axis.
+    ``slots`` and ``admit_cap`` must divide the mesh."""
+
+    def __init__(self, swarm: Swarm, cfg: SwarmConfig, slots: int,
+                 mesh, capacity_factor: float = 2.0,
+                 admit_cap: int | None = None):
+        super().__init__(swarm, cfg, slots, admit_cap)
+        from ..parallel.mesh import AXIS
+        self.mesh, self.capacity_factor = mesh, capacity_factor
+        d = mesh.shape[AXIS]
+        if slots % d or self.admit_cap % d:
+            raise ValueError(f"serve slots {slots} and admit_cap "
+                             f"{self.admit_cap} must divide the "
+                             f"{d}-device mesh")
+
+    def admit(self, st, keys, slots, key, rnd):
+        # Routed seed exchange (shard-local origin folding inside the
+        # init body), then one GSPMD scatter into the resident state.
+        from ..parallel.sharded import _sharded_lookup_init
+        new = _sharded_lookup_init(self.swarm, self.cfg, keys, key,
+                                   self.mesh, self.capacity_factor)
+        return _scatter_admission(st, new, slots, jnp.int32(rnd))
+
+    def step(self, st, rnd):
+        from ..parallel.sharded import _sharded_lookup_step
+        return _sharded_lookup_step(self.swarm, self.cfg, st, self.mesh,
+                                    self.capacity_factor,
+                                    rnd=jnp.int32(rnd))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_admission(st: LookupState, new: LookupState,
+                       slots: jax.Array, rnd: jax.Array) -> LookupState:
+    return _scatter_rows_into(st, new, slots, rnd)
+
+
+def poisson_zipf_events(rate: float, duration: float, key_pool: int,
+                        zipf_s: float, seed: int = 0,
+                        hot_frac: float = 0.01):
+    """Open-loop request schedule: Poisson(``rate``) arrival timestamps
+    over ``[0, duration)`` with Zipf(``zipf_s``)-popular keys drawn
+    from a ``key_pool``-key universe (``zipf_s = 0`` → uniform).
+
+    Returns ``(arrival_ts [R] float64, keys [R,5] uint32 jnp,
+    klass [R] array of "hot"/"cold")`` — a key is "hot" when its
+    popularity rank falls in the top ``hot_frac`` of the pool, the
+    request-class axis of the latency histograms.
+    """
+    if rate <= 0 or duration <= 0:
+        raise ValueError("rate and duration must be > 0")
+    rng = np.random.default_rng(seed)
+    # Inter-arrival exponentials until the horizon (Poisson process).
+    n_est = int(rate * duration * 1.5) + 64
+    while True:
+        gaps = rng.exponential(1.0 / rate, size=n_est)
+        ts = np.cumsum(gaps)
+        if ts[-1] >= duration:
+            break
+        n_est *= 2
+    ts = ts[ts < duration]
+    r = len(ts)
+    pool = np.asarray(jax.random.bits(jax.random.PRNGKey(seed ^ 0x5EED),
+                                      (key_pool, N_LIMBS), jnp.uint32))
+    if zipf_s > 0:
+        rnk = np.arange(1, key_pool + 1, dtype=np.float64)
+        prob = rnk ** -zipf_s
+        prob /= prob.sum()
+        draw = rng.choice(key_pool, size=r, p=prob)
+    else:
+        draw = rng.integers(0, key_pool, size=r)
+    hot_cut = max(1, int(key_pool * hot_frac))
+    klass = np.where(draw < hot_cut, "hot", "cold")
+    # Keys stay HOST-side numpy: the serve loop gathers each admission
+    # micro-batch on the host and ships ONE padded array to the device
+    # — a jnp key matrix here would put a device gather + blocking
+    # readback + re-upload inside every admission of the measured loop.
+    return ts, pool[draw], klass
+
+
+def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
+                    klass=None, burst: int = 2,
+                    duration: float | None = None,
+                    overload_queue_factor: int = 8,
+                    drain_round_cap: int | None = None) -> dict:
+    """Drive the serve engine against an open-loop arrival schedule.
+
+    ``arrival_ts``/``keys``(/``klass``) come from
+    :func:`poisson_zipf_events` (or any sorted schedule).  The wall
+    clock starts AFTER a warm pass compiled every program (compile must
+    not masquerade as queueing delay); requests then arrive strictly by
+    their timestamps — if the engine falls behind, the queue grows, and
+    past ``overload_queue_factor × slots`` the run aborts with
+    :class:`ServeOverloadError` (the open-loop contract: arrivals never
+    wait for the server).  A request that hasn't converged within
+    ``cfg.max_steps`` rounds of its admission is EXPIRED (slot
+    retired and recycled, booked as ``expired``, never as a latency
+    sample) — the serve twin of the batch engine's round cap, so a
+    non-converging lookup can't squat on a slot until the queue reads
+    as overload.  After the schedule is exhausted the loop drains
+    in-flight work, capped at ``drain_round_cap`` rounds (leftovers
+    are reported as ``in_flight`` — the checker's ``admitted ==
+    completed + in_flight + expired`` conservation still holds).
+
+    Returns the serve report dict (see the module docstring for the
+    latency reconstruction); per-request arrays are ordered by
+    completion observation.
+    """
+    cfg, c = engine.cfg, engine.slots
+    a_cap = engine.admit_cap
+    keys = np.asarray(keys)        # host-side: see poisson_zipf_events
+    r_total = len(arrival_ts)
+    if klass is None:
+        klass = np.full(r_total, "all")
+    drain_cap = drain_round_cap or 4 * cfg.max_steps
+    if duration is None:
+        duration = float(arrival_ts[-1]) if r_total else 0.0
+    # Absolute backstop: a run that can't even drain by 5x the schedule
+    # horizon is overloaded whatever the queue gauge says.
+    hard_wall = duration * 5.0 + 30.0
+
+    # --- warm pass: compile admit/step/snapshot off the clock.
+    st = engine.empty()
+    warm_keys = jnp.zeros((a_cap, N_LIMBS), jnp.uint32)
+    warm_slots = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.full((a_cap - 1,), c, jnp.int32)]) if a_cap > 1 \
+        else jnp.zeros((1,), jnp.int32)
+    st = engine.admit(st, warm_keys, warm_slots,
+                      jax.random.PRNGKey(0), 0)
+    st = engine.step(st, 0)
+    engine.snapshot(st)
+    # Expire compiles too: its first real use is mid-run by definition
+    # (a request aging past max_steps), where a fresh jit would land
+    # inside a burst wall mark and read as tail latency.
+    st = engine.expire(st, jnp.full((a_cap,), c, jnp.int32))
+    st = engine.empty()
+
+    free = list(range(c - 1, -1, -1))     # pop() → lowest slot first
+    occupied: dict[int, int] = {}         # slot -> request index
+    queue: list[int] = []
+    next_ev = 0
+    rnd = 0
+    adm_i = 0
+    marks_r = [0]
+    marks_w = [0.0]
+    # Per completed request (completion-observation order):
+    rec_req, rec_lat, rec_hops, rec_rounds, rec_found = [], [], [], [], []
+    admit_wall = {}
+    queue_depths = []
+    occ_samples = []
+    admitted = completed = expired = 0
+    drain_rounds = 0
+    overload = overload_queue_factor * c
+
+    t0 = time.perf_counter()
+    while True:
+        now = time.perf_counter() - t0
+        while next_ev < r_total and arrival_ts[next_ev] <= now:
+            queue.append(next_ev)
+            next_ev += 1
+        if len(queue) > overload:
+            raise ServeOverloadError(
+                f"serve overload: admission queue reached {len(queue)} "
+                f"requests (> {overload_queue_factor} x {c} slots) at "
+                f"t={now:.2f}s — the arrival rate exceeds what this "
+                f"slot capacity sustains on this machine; lower "
+                f"--arrival-rate or raise --serve-slots")
+        if now > hard_wall:
+            raise ServeOverloadError(
+                f"serve overload: run exceeded the {hard_wall:.0f}s "
+                f"hard wall ({r_total - next_ev + len(queue)} requests "
+                f"not yet admitted, {len(occupied)} in flight) — the "
+                f"arrival rate exceeds serve capacity on this machine")
+        queue_depths.append(len(queue))
+
+        # --- admit one micro-batch into recycled slots
+        m = min(len(queue), len(free), a_cap)
+        if m:
+            take = queue[:m]
+            del queue[:m]
+            slots_np = np.full(a_cap, c, np.int32)
+            keys_np = np.zeros((a_cap, N_LIMBS), np.uint32)
+            for j, ri in enumerate(take):
+                slot = free.pop()
+                slots_np[j] = slot
+                occupied[slot] = ri
+                admit_wall[ri] = now
+            keys_np[:m] = keys[np.asarray(take)]
+            st = engine.admit(st, jnp.asarray(keys_np),
+                              jnp.asarray(slots_np),
+                              jax.random.fold_in(key, adm_i), rnd)
+            adm_i += 1
+            admitted += m
+
+        draining = next_ev >= r_total and not queue
+        if draining and not occupied:
+            break
+        if not occupied and not queue:
+            # Idle gap between arrivals: sleep to the next event rather
+            # than spinning dispatches on an empty state.
+            if next_ev < r_total:
+                gap = arrival_ts[next_ev] - (time.perf_counter() - t0)
+                if gap > 0:
+                    time.sleep(min(gap, 0.05))
+                continue
+            break
+
+        # --- burst + harvest (the one sync per iteration)
+        for _ in range(burst):
+            st = engine.step(st, rnd)
+            rnd += 1
+        done, hops, adm_r, com_r, found = engine.snapshot(st)
+        w = time.perf_counter() - t0
+        marks_r.append(rnd)
+        marks_w.append(w)
+        occ_samples.append(len(occupied) / c)
+
+        for slot in [s for s, _ in occupied.items() if done[s]]:
+            ri = occupied.pop(slot)
+            free.append(slot)
+            cr = int(com_r[slot])
+            if cr < 0:
+                # Done with no completion stamp can only mean a forced
+                # retirement — book it as expired, never as a latency
+                # sample (conservation: admitted = completed +
+                # in-flight + expired).
+                expired += 1
+                continue
+            # Round-end wall: interpolated inside the burst, floored at
+            # the admission wall so queueing delay is counted and a
+            # sub-burst completion can never interpolate before its own
+            # arrival.  Only the last two marks matter: every done row
+            # is harvested in the burst it completed (the snapshot
+            # follows the burst and pops all done slots), so walking
+            # the whole mark history per completion would be O(n²)
+            # host work inside the clocked loop for nothing.
+            cw = float(np.interp(cr + 1, marks_r[-2:], marks_w[-2:]))
+            cw = max(cw, admit_wall[ri])
+            rec_req.append(ri)
+            rec_lat.append(cw - float(arrival_ts[ri]))
+            rec_hops.append(int(hops[slot]))
+            rec_rounds.append(cr - int(adm_r[slot]) + 1)
+            rec_found.append(int(found[slot, 0]) >= 0)
+            completed += 1
+
+        # --- expiry: rows past their round budget (the batch engine's
+        # max_steps cap) retire instead of squatting on their slot.
+        # One fixed-width (padded) expire program; a pathological
+        # backlog wider than admit_cap drains over later iterations.
+        stale = [s for s in occupied
+                 if not done[s] and rnd - int(adm_r[s]) >= cfg.max_steps]
+        if stale:
+            batch = stale[:a_cap]
+            sl = np.full(a_cap, c, np.int32)
+            sl[:len(batch)] = batch
+            st = engine.expire(st, jnp.asarray(sl))
+            for slot in batch:
+                ri = occupied.pop(slot)
+                free.append(slot)
+                expired += 1
+        if draining:
+            drain_rounds += burst
+            if drain_rounds > drain_cap:
+                break
+
+    elapsed = time.perf_counter() - t0
+    return {
+        "slots": c,
+        "admit_cap": a_cap,
+        "burst": burst,
+        "admitted": admitted,
+        "completed": completed,
+        "expired": expired,
+        "in_flight": len(occupied),
+        "never_admitted": len(queue) + (r_total - next_ev),
+        "rounds": rnd,
+        "elapsed_s": elapsed,
+        "sustained_rps": completed / elapsed if elapsed > 0 else 0.0,
+        "request": np.asarray(rec_req, np.int64),
+        "latency_s": np.asarray(rec_lat, np.float64),
+        "hops": np.asarray(rec_hops, np.int64),
+        "service_rounds": np.asarray(rec_rounds, np.int64),
+        "found_nonempty": np.asarray(rec_found, bool),
+        "klass": np.asarray(klass)[np.asarray(rec_req, np.int64)]
+        if completed else np.asarray([], dtype="<U4"),
+        "queue_depth_mean": float(np.mean(queue_depths))
+        if queue_depths else 0.0,
+        "queue_depth_max": int(np.max(queue_depths))
+        if queue_depths else 0,
+        "slot_occupancy_frac": float(np.mean(occ_samples))
+        if occ_samples else 0.0,
+        "burst_marks": list(zip(marks_r, marks_w)),
+    }
+
+
+def closed_loop_replay(swarm: Swarm, cfg: SwarmConfig,
+                       targets: jax.Array, key: jax.Array
+                       ) -> tuple[LookupResult, LookupState]:
+    """Feed a fixed batch through the serve engine's admit/step path
+    (slots = L, everything admitted at round 0) and run to completion.
+
+    This is the serve twin of ``lookup(swarm, cfg, targets, key)`` and
+    must produce bit-identical found/hops/done for the same key: the
+    admission seed exchange is ``init_impl`` with the batch engine's
+    origin draw, the rounds are the same shared step, and finished
+    slots simply freeze (nothing recycles in a closed-loop replay) —
+    asserted in tests/test_serve.py, mirroring test_compaction.py's
+    seed-identity pattern.  Returns ``(LookupResult, final state)`` so
+    callers can inspect the lifecycle rows.
+    """
+    l = targets.shape[0]
+    eng = ServeEngine(swarm, cfg, slots=l, admit_cap=l)
+    st = eng.empty()
+    st = eng.admit(st, targets, jnp.arange(l, dtype=jnp.int32), key, 0)
+    rnd = 0
+    burst = burst_schedule(cfg)
+    while rnd < cfg.max_steps:
+        n = min(burst, cfg.max_steps - rnd)
+        for _ in range(n):
+            st = eng.step(st, rnd)
+            rnd += 1
+        if bool(jnp.all(st.done)):
+            break
+        burst = 2
+    res = LookupResult(found=_finalize(swarm.ids, st, cfg),
+                       hops=st.hops, done=st.done)
+    return res, st

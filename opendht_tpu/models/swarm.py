@@ -294,12 +294,40 @@ class LookupState(NamedTuple):
     queried: jax.Array  # [L,S] bool
     done: jax.Array     # [L] bool
     hops: jax.Array     # [L] int32 — solicitation rounds until sync
+    # Per-request lifecycle plane (OFF by default: ``None`` keeps every
+    # existing program and pytree structure untouched).  When attached
+    # (:func:`init_lifecycle`), each row records the round it was
+    # admitted at and the round its ``done`` bit first went True — a
+    # PURE OBSERVER: neither field feeds any round decision, so
+    # results/strikes/traces are bit-identical with tracking on or off
+    # (tests/test_serve.py).  The fields ride the compaction repack
+    # like every other row vector and cost zero extra host syncs;
+    # combined with the burst loop's per-burst wall clocks they
+    # reconstruct arrival→completion wall latency per request without a
+    # per-row device_get — the device half of the serve telemetry
+    # plane (models/serve.py, ROADMAP #2).
+    admitted_round: jax.Array | None = None   # [L] int32 (-1 = free)
+    completed_round: jax.Array | None = None  # [L] int32 (-1 = inflight)
 
 
 class LookupResult(NamedTuple):
     found: jax.Array  # [L,quorum] closest queried node indices (-1 pad)
     hops: jax.Array   # [L]
     done: jax.Array   # [L]
+
+
+def init_lifecycle(st: LookupState,
+                   rnd: int | jax.Array = 0) -> LookupState:
+    """Attach the per-request lifecycle plane to a fresh state: every
+    row admitted at round ``rnd``, completion pending.  Steps must then
+    receive their round index (``rnd=``) so ``_merge_round`` can stamp
+    ``completed_round`` — the loops do this automatically when the
+    fields are present."""
+    l = st.done.shape[0]
+    return st._replace(
+        admitted_round=jnp.full((l,), rnd, jnp.int32),
+        completed_round=jnp.where(st.done, jnp.asarray(rnd, jnp.int32),
+                                  jnp.int32(-1)))
 
 
 class LookupTrace(NamedTuple):
@@ -1010,9 +1038,22 @@ def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
     # state (equal-d0 ties order by node index from pass 1, independent
     # of input order) — f_* already equal st.* bit-for-bit for done
     # rows.  The wheres cost three [L,S] copies per round.
+    completed = st.completed_round
+    if completed is not None:
+        # Lifecycle stamp (pure observer — nothing downstream reads
+        # it): the round a row's done bit first went True.  Free serve
+        # slots (admitted_round = -1) are already done, so they can
+        # never restamp.
+        if rnd is None:
+            raise ValueError(
+                "lifecycle tracking needs the round index: pass rnd= "
+                "to the step (the loops do when the fields are present)")
+        completed = jnp.where(done & ~st.done,
+                              jnp.asarray(rnd, jnp.int32), completed)
     new_st = LookupState(
         targets=st.targets, idx=f_idx, dist=f_dist, queried=f_q,
-        done=done, hops=st.hops + active.astype(jnp.int32))
+        done=done, hops=st.hops + active.astype(jnp.int32),
+        admitted_round=st.admitted_round, completed_round=completed)
     if trace is None:
         return new_st
     i32 = jnp.int32
@@ -1089,15 +1130,19 @@ def lookup_init(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def lookup_step(swarm: Swarm, cfg: SwarmConfig,
-                st: LookupState) -> LookupState:
+def lookup_step(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+                rnd: jax.Array | None = None) -> LookupState:
+    """One plain round.  ``rnd`` (the loop's round index) is only
+    needed — and only passed by the loops — when the state carries the
+    lifecycle plane; without it the program is byte-identical to the
+    pre-lifecycle step."""
     return step_impl(swarm.ids, swarm.alive, _local_respond(swarm, cfg),
-                     cfg, st)
+                     cfg, st, rnd=rnd)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
-def _lookup_step_d(swarm: Swarm, cfg: SwarmConfig,
-                   st: LookupState) -> LookupState:
+def _lookup_step_d(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+                   rnd: jax.Array | None = None) -> LookupState:
     """:func:`lookup_step` with the state DONATED — the burst-loop
     carry is single-owner, so XLA reuses its buffers in place instead
     of holding input+output copies across every round (and across the
@@ -1105,12 +1150,13 @@ def _lookup_step_d(swarm: Swarm, cfg: SwarmConfig,
     keep the non-donating :func:`lookup_step`, whose inputs stay
     valid."""
     return step_impl(swarm.ids, swarm.alive, _local_respond(swarm, cfg),
-                     cfg, st)
+                     cfg, st, rnd=rnd)
 
 
 def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
            key: jax.Array, compact: bool = True,
-           stats: dict | None = None) -> LookupResult:
+           stats: dict | None = None,
+           track_lifecycle: bool = False) -> LookupResult:
     """Run a batch of iterative lookups to completion.
 
     ``targets``: ``[L,5]``.  Origins are random alive nodes (each
@@ -1140,6 +1186,13 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     existing done-check and tracks the true tail.)  ``stats`` receives
     the dispatch-attribution fields (see
     :func:`run_compacted_burst_loop`).
+
+    ``track_lifecycle`` attaches the per-request lifecycle plane
+    (``admitted_round``/``completed_round`` — see :class:`LookupState`)
+    to the loop carry: a pure observer (bit-identical results, asserted
+    in tests/test_serve.py); the per-row round indices land in
+    ``stats["admitted_round"]``/``stats["completed_round"]`` (original
+    batch order) when a ``stats`` dict is passed.
     """
     l = targets.shape[0]
     # Phase attribution (bench satellite): with ``stats["time_phases"]``
@@ -1152,18 +1205,30 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     # Origins are drawn from *alive* nodes: the issuing node exists.
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
+    if track_lifecycle:
+        st = init_lifecycle(st)
+    rnd_of = (lambda r: jnp.int32(r)) if track_lifecycle \
+        else (lambda r: None)
     if timing:
         jax.block_until_ready(st)
         t1 = time.perf_counter()
         stats["init_s"] = t1 - t0
     if not compact:
-        st = run_burst_loop(lambda s, r: lookup_step(swarm, cfg, s), st,
-                            cfg)
+        st = run_burst_loop(
+            lambda s, r: lookup_step(swarm, cfg, s, rnd_of(r)), st, cfg)
+        if track_lifecycle and stats is not None:
+            stats["admitted_round"] = st.admitted_round
+            stats["completed_round"] = st.completed_round
         return LookupResult(found=_finalize(swarm.ids, st, cfg),
                             hops=st.hops, done=st.done)
     st, _, order = run_compacted_burst_loop(
-        lambda s, ex, r, hidden: (_lookup_step_d(swarm, cfg, s), ex),
+        lambda s, ex, r, hidden: (_lookup_step_d(swarm, cfg, s,
+                                                 rnd_of(r)), ex),
         st, cfg, stats=stats)
+    if track_lifecycle and stats is not None:
+        stats["admitted_round"] = _scatter_rows(st.admitted_round, order)
+        stats["completed_round"] = _scatter_rows(st.completed_round,
+                                                 order)
     if timing:
         jax.block_until_ready(st)
         t2 = time.perf_counter()
@@ -1269,7 +1334,10 @@ def _stable_done_perm(done: jax.Array) -> jax.Array:
 
 
 def _permute_state(st: LookupState, perm: jax.Array) -> LookupState:
-    return LookupState(*[jnp.take(x, perm, axis=0) for x in st])
+    # The lifecycle fields are None when tracking is off — skip, don't
+    # crash (same guard in every generic per-field helper below).
+    return LookupState(*[x if x is None else jnp.take(x, perm, axis=0)
+                         for x in st])
 
 
 @partial(jax.jit, static_argnames=("w",), donate_argnums=(0, 1))
@@ -1278,7 +1346,8 @@ def _compact_slice(st: LookupState, order: jax.Array, w: int):
     full state, the row provenance, and the ``[:w]`` dispatch view."""
     perm = _stable_done_perm(st.done)
     full = _permute_state(st, perm)
-    return full, order[perm], LookupState(*[x[:w] for x in full])
+    return full, order[perm], LookupState(
+        *[x if x is None else x[:w] for x in full])
 
 
 @partial(jax.jit, static_argnames=("w",), donate_argnums=(0, 1))
@@ -1289,16 +1358,19 @@ def _compact_resize(full: LookupState, order: jax.Array,
     [w_old] ``sub`` is not donated — its buffers can alias neither the
     [L] full state nor the narrower new slice."""
     wo = sub.done.shape[0]
-    full = LookupState(*[f.at[:wo].set(s) for f, s in zip(full, sub)])
+    full = LookupState(*[f if f is None else f.at[:wo].set(s)
+                         for f, s in zip(full, sub)])
     perm = _stable_done_perm(full.done)
     full = _permute_state(full, perm)
-    return full, order[perm], LookupState(*[x[:w] for x in full])
+    return full, order[perm], LookupState(
+        *[x if x is None else x[:w] for x in full])
 
 
 @partial(jax.jit, donate_argnums=(0,))
 def _writeback_prefix(full: LookupState, sub: LookupState) -> LookupState:
     wo = sub.done.shape[0]
-    return LookupState(*[f.at[:wo].set(s) for f, s in zip(full, sub)])
+    return LookupState(*[f if f is None else f.at[:wo].set(s)
+                         for f, s in zip(full, sub)])
 
 
 def _scatter_rows(x: jax.Array, order: jax.Array) -> jax.Array:
@@ -1411,7 +1483,8 @@ def _traced_lookup_step_d(swarm: Swarm, cfg: SwarmConfig,
 
 def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                   key: jax.Array, compact: bool = True,
-                  stats: dict | None = None
+                  stats: dict | None = None,
+                  track_lifecycle: bool = False
                   ) -> tuple[LookupResult, LookupTrace]:
     """:func:`lookup` with the flight recorder on: identical semantics
     and seeds (same origins, same solicitation schedule — the trace
@@ -1431,6 +1504,8 @@ def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     t0 = time.perf_counter() if timing else 0.0
     origins = _sample_origins(key, swarm.alive, l)
     st = lookup_init(swarm, cfg, targets, origins)
+    if track_lifecycle:
+        st = init_lifecycle(st)
     trace = empty_lookup_trace(cfg)
     if timing:
         jax.block_until_ready(st)
@@ -1441,6 +1516,9 @@ def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
             lambda c, r: traced_lookup_step(swarm, cfg, c[0], c[1],
                                             jnp.int32(r)),
             (st, trace), cfg, done_of=lambda c: c[0].done)
+        if track_lifecycle and stats is not None:
+            stats["admitted_round"] = st.admitted_round
+            stats["completed_round"] = st.completed_round
         return (LookupResult(found=_finalize(swarm.ids, st, cfg),
                              hops=st.hops, done=st.done), trace)
 
@@ -1451,6 +1529,10 @@ def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
 
     st, (trace,), order = run_compacted_burst_loop(
         step, st, cfg, extras=(trace,), stats=stats)
+    if track_lifecycle and stats is not None:
+        stats["admitted_round"] = _scatter_rows(st.admitted_round, order)
+        stats["completed_round"] = _scatter_rows(st.completed_round,
+                                                 order)
     if timing:
         jax.block_until_ready(st)
         t2 = time.perf_counter()
@@ -1868,7 +1950,8 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                  key: jax.Array,
                  faults: LookupFaults = LookupFaults(),
                  collect_trace: bool = False, compact: bool = True,
-                 stats: dict | None = None):
+                 stats: dict | None = None,
+                 track_lifecycle: bool = False):
     """Run a batch of lookups to completion UNDER the adversarial
     fault model (Byzantine responders + exchange loss) with the
     strike/blacklist defense — the lookup-path twin of the storage
@@ -1896,6 +1979,10 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                     else swarm.alive & ~swarm.byzantine)
     origins = _sample_origins(key, honest_alive, l)
     st = chaos_lookup_init(swarm, cfg, targets, origins)
+    if track_lifecycle:
+        # The chaos steps always carry their round index (the fault
+        # stream's coordinate), so lifecycle needs no extra plumbing.
+        st = init_lifecycle(st)
     strikes = jnp.zeros((cfg.n_nodes,), jnp.int32)
     byz_aux = (byz_colluder_pool(swarm.byzantine)
                if faults.eclipse and swarm.byzantine is not None
@@ -1919,6 +2006,11 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         extras = (strikes, trace0) if collect_trace else (strikes,)
         st, extras, order = run_compacted_burst_loop(
             step, st, cfg, extras=extras, stats=stats)
+        if track_lifecycle and stats is not None:
+            stats["admitted_round"] = _scatter_rows(st.admitted_round,
+                                                    order)
+            stats["completed_round"] = _scatter_rows(st.completed_round,
+                                                     order)
         strikes = extras[0]
         if collect_trace:
             trace = extras[1]
@@ -1944,6 +2036,9 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
             lambda c, r: chaos_lookup_step(swarm, cfg, faults, c[0],
                                            c[1], jnp.int32(r), byz_aux),
             (st, strikes), cfg, done_of=lambda c: c[0].done)
+    if track_lifecycle and stats is not None:
+        stats["admitted_round"] = st.admitted_round
+        stats["completed_round"] = st.completed_round
     found = _finalize(swarm.ids, st, cfg)
     found = _censor_convicted(found, strikes, cfg, faults)
     res = LookupResult(found=found, hops=st.hops, done=st.done)

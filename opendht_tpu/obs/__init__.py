@@ -10,6 +10,10 @@ alpha-select / merge / scatter-writeback against the fused round.
 compute- vs memory- vs issue-bound verdict.
 """
 
+from .latency import (  # noqa: F401
+    LatencyPlane,
+    publish_hop_histogram,
+)
 from .ledger import (  # noqa: F401
     CostLedger,
     hbm_watermark,

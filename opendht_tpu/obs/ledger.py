@@ -77,6 +77,10 @@ ENTRY_POINTS: tuple = (
     ("opendht_tpu.models.swarm", "_evict_blacklisted", (0,)),
     ("opendht_tpu.models.swarm", "_finalize", ()),
     ("opendht_tpu.models.swarm", "_finalize_scattered", ()),
+    ("opendht_tpu.models.serve", "_admit", (2,)),
+    ("opendht_tpu.models.serve", "_scatter_admission", (0,)),
+    ("opendht_tpu.models.serve", "_snapshot", ()),
+    ("opendht_tpu.models.serve", "_expire_slots", (0,)),
     ("opendht_tpu.models.storage", "_store_insert", ()),
     ("opendht_tpu.models.storage", "_announce_insert", ()),
     ("opendht_tpu.models.storage", "_get_probe", ()),

@@ -57,6 +57,7 @@ from ..models.swarm import (
     device_hbm_bytes,
     empty_lookup_trace,
     init_impl,
+    init_lifecycle,
     lookup,
     run_burst_loop,
     step_impl,
@@ -363,11 +364,15 @@ def _sharded_lookup_while(swarm: Swarm, cfg: SwarmConfig,
 
 
 def _make_respond_body(cfg, n_shards, capacity_factor, local_respond,
-                       init, cap_nq=None):
+                       init, cap_nq=None, with_rnd=False):
     """Single-round shard_map bodies for the burst path (same respond
     contract as the while formulation via ``_make_responders``).
     ``cap_nq`` pins capacity provisioning to the full batch width for
-    compaction-truncated dispatches (see ``_route_respond``)."""
+    compaction-truncated dispatches (see ``_route_respond``).
+    ``with_rnd`` adds the round index as a replicated argument — only
+    lifecycle-tracked states need it (``_merge_round``'s
+    ``completed_round`` stamp), so untracked programs stay
+    byte-identical."""
     def init_body(ids, tables_local, alive, targets, key):
         ll = targets.shape[0]
         me = jax.lax.axis_index(AXIS)
@@ -384,13 +389,27 @@ def _make_respond_body(cfg, n_shards, capacity_factor, local_respond,
             tables_local, alive, cap_nq=cap_nq)
         return step_impl(ids, alive, respond, cfg, st)
 
-    return init_body if init else step_body
+    def step_body_rnd(ids, tables_local, alive, st, rnd):
+        _, respond = _make_responders(
+            cfg, n_shards, capacity_factor, local_respond, ids,
+            tables_local, alive, cap_nq=cap_nq)
+        return step_impl(ids, alive, respond, cfg, st, rnd=rnd)
+
+    if init:
+        return init_body
+    return step_body_rnd if with_rnd else step_body
 
 
-def _st_specs():
+def _st_specs(track: bool = False):
+    """Per-field partition specs for a LookupState.  ``track`` adds the
+    lifecycle rows (sharded on the lookup axis like ``done``); without
+    it the lifecycle positions are ``None``, matching the empty pytree
+    slots of an untracked state."""
+    lif = P(AXIS) if track else None
     return LookupState(targets=P(AXIS, None), idx=P(AXIS, None),
                        dist=P(AXIS, None), queried=P(AXIS, None),
-                       done=P(AXIS), hops=P(AXIS))
+                       done=P(AXIS), hops=P(AXIS),
+                       admitted_round=lif, completed_round=lif)
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor",
@@ -411,15 +430,21 @@ def _sharded_lookup_init(swarm, cfg, targets, key, mesh,
                                    "local_respond", "cap_nq"),
          donate_argnums=(2,))
 def _sharded_lookup_step(swarm, cfg, st, mesh, capacity_factor,
-                         local_respond=False, cap_nq=None):
+                         local_respond=False, cap_nq=None, rnd=None):
     n_shards = mesh.shape[AXIS]
-    fn = shard_map(
-        _make_respond_body(cfg, n_shards, capacity_factor,
-                           local_respond, init=False, cap_nq=cap_nq),
-        mesh=mesh,
-        in_specs=(P(), P(AXIS, None), P(), _st_specs()),
-        out_specs=_st_specs(), check_vma=False)
-    return fn(swarm.ids, swarm.tables, swarm.alive, st)
+    track = st.admitted_round is not None
+    with_rnd = rnd is not None
+    body = _make_respond_body(cfg, n_shards, capacity_factor,
+                              local_respond, init=False, cap_nq=cap_nq,
+                              with_rnd=with_rnd)
+    in_specs = (P(), P(AXIS, None), P(), _st_specs(track))
+    args = (swarm.ids, swarm.tables, swarm.alive, st)
+    if with_rnd:
+        in_specs = in_specs + (P(),)
+        args = args + (rnd,)
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=_st_specs(track), check_vma=False)
+    return fn(*args)
 
 
 def _table_bytes_per_device(cfg: SwarmConfig, n_shards: int) -> int:
@@ -448,41 +473,54 @@ def _table_bytes_per_device(cfg: SwarmConfig, n_shards: int) -> int:
 # plain compaction is seed-identical always.
 
 def _sharded_compact_slice(st, order, mesh, w):
+    track = st.admitted_round is not None
+
     def body(st, order):
         perm = _stable_done_perm(st.done)
         full = _permute_state(st, perm)
-        return full, order[perm], LookupState(*[x[:w] for x in full])
+        return full, order[perm], LookupState(
+            *[x if x is None else x[:w] for x in full])
 
-    fn = shard_map(body, mesh=mesh, in_specs=(_st_specs(), P(AXIS)),
-                   out_specs=(_st_specs(), P(AXIS), _st_specs()),
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(_st_specs(track), P(AXIS)),
+                   out_specs=(_st_specs(track), P(AXIS),
+                              _st_specs(track)),
                    check_vma=False)
     return fn(st, order)
 
 
 def _sharded_compact_resize(full, order, sub, mesh, w):
+    track = full.admitted_round is not None
+
     def body(full, order, sub):
         wo = sub.done.shape[0]
-        full = LookupState(*[f.at[:wo].set(s)
+        full = LookupState(*[f if f is None else f.at[:wo].set(s)
                              for f, s in zip(full, sub)])
         perm = _stable_done_perm(full.done)
         full = _permute_state(full, perm)
-        return full, order[perm], LookupState(*[x[:w] for x in full])
+        return full, order[perm], LookupState(
+            *[x if x is None else x[:w] for x in full])
 
     fn = shard_map(body, mesh=mesh,
-                   in_specs=(_st_specs(), P(AXIS), _st_specs()),
-                   out_specs=(_st_specs(), P(AXIS), _st_specs()),
+                   in_specs=(_st_specs(track), P(AXIS),
+                             _st_specs(track)),
+                   out_specs=(_st_specs(track), P(AXIS),
+                              _st_specs(track)),
                    check_vma=False)
     return fn(full, order, sub)
 
 
 def _sharded_writeback(full, sub, mesh):
+    track = full.admitted_round is not None
+
     def body(full, sub):
         wo = sub.done.shape[0]
-        return LookupState(*[f.at[:wo].set(s)
+        return LookupState(*[f if f is None else f.at[:wo].set(s)
                              for f, s in zip(full, sub)])
 
-    fn = shard_map(body, mesh=mesh, in_specs=(_st_specs(), _st_specs()),
-                   out_specs=_st_specs(), check_vma=False)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(_st_specs(track), _st_specs(track)),
+                   out_specs=_st_specs(track), check_vma=False)
     return fn(full, sub)
 
 
@@ -541,7 +579,8 @@ def _rebalance_body(cfg, n_shards, w, st, order):
     got = jnp.zeros((ll, pay.shape[1]), jnp.uint32
                     ).at[rpos].set(back, mode="drop")
     full, order = _unpack_rows(got, cfg.search_width)
-    return full, order, LookupState(*[x[:w] for x in full])
+    return full, order, LookupState(
+        *[x if x is None else x[:w] for x in full])
 
 
 def _sharded_rebalance_slice(st, order, cfg, mesh, w):
@@ -558,7 +597,7 @@ def _sharded_rebalance_resize(full, order, sub, cfg, mesh, w):
 
     def body(full, order, sub):
         wo = sub.done.shape[0]
-        full = LookupState(*[f.at[:wo].set(s)
+        full = LookupState(*[f if f is None else f.at[:wo].set(s)
                              for f, s in zip(full, sub)])
         return _rebalance_body(cfg, n_shards, w, full, order)
 
@@ -591,7 +630,8 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                    local_respond: bool = False,
                    compact: bool | None = None,
                    rebalance: bool = False,
-                   stats: dict | None = None) -> LookupResult:
+                   stats: dict | None = None,
+                   track_lifecycle: bool = False) -> LookupResult:
     """Full lookup batch with routing tables sharded over ``mesh``.
 
     ``swarm.tables`` is sharded on the node axis; ``ids`` and ``alive``
@@ -625,24 +665,41 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     if rebalance and compact is False:
         raise ValueError("rebalance=True requires the compacted burst "
                          "formulation (compact must not be False)")
+    if track_lifecycle and rebalance:
+        # The rebalance shuffle serializes a fixed row layout
+        # (_pack_rows) that does not carry the lifecycle columns.
+        raise ValueError("track_lifecycle is not supported with "
+                         "rebalance=True")
     n_shards = mesh.shape[AXIS]
     fits_while = (2 * _table_bytes_per_device(cfg, n_shards)
                   + LOOKUP_HEADROOM_BYTES <= device_hbm_bytes())
-    if compact is not True and not rebalance and fits_while:
+    if compact is not True and not rebalance and not track_lifecycle \
+            and fits_while:
         if stats is not None:
             stats["formulation"] = "while"
         return _sharded_lookup_while(swarm, cfg, targets, key, mesh,
                                      capacity_factor, local_respond)
     st = _sharded_lookup_init(swarm, cfg, targets, key, mesh,
                               capacity_factor, local_respond)
+    if track_lifecycle:
+        # Burst formulations only: the lifecycle rows ride the host-
+        # driven carry (the while formulation's on-device loop has no
+        # host round counter to stamp from).
+        st = init_lifecycle(st)
+    rnd_of = (lambda r: jnp.int32(r)) if track_lifecycle \
+        else (lambda r: None)
     if compact is False:
         if stats is not None:
             stats["formulation"] = "burst"
         st = run_burst_loop(
             lambda s, r: _sharded_lookup_step(swarm, cfg, s, mesh,
                                               capacity_factor,
-                                              local_respond),
+                                              local_respond,
+                                              rnd=rnd_of(r)),
             st, cfg)
+        if track_lifecycle and stats is not None:
+            stats["admitted_round"] = st.admitted_round
+            stats["completed_round"] = st.completed_round
         found = _finalize(swarm.ids, st, cfg)
         return LookupResult(found=found, hops=st.hops, done=st.done)
 
@@ -662,7 +719,7 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         for _ in range(n):
             sub = _sharded_lookup_step(swarm, cfg, sub, mesh,
                                        capacity_factor, local_respond,
-                                       cap_nq)
+                                       cap_nq, rnd=rnd_of(rounds))
             rounds += 1
             row_rounds += w * n_shards
         if w not in widths:
@@ -694,6 +751,11 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                         full, order, sub, mesh, w_new)
                 w = w_new
     full = _sharded_writeback(full, sub, mesh) if w < ll else sub
+    if track_lifecycle and stats is not None:
+        stats["admitted_round"] = _scatter_rows(full.admitted_round,
+                                                order)
+        stats["completed_round"] = _scatter_rows(full.completed_round,
+                                                 order)
     if stats is not None:
         stats["formulation"] = ("burst-rebalanced" if rebalance
                                 else "burst-compacted")

@@ -22,6 +22,12 @@ Checks, in decreasing severity:
 * ``median_hops`` must not grow by more than 0.5 (a compaction or
   schedule bug that trades rounds for rate shows up here).
 
+SERVE rows (``swarm_serve_req_per_sec`` — from ``--mode serve`` or its
+``swarm_serve_trace`` artifact) additionally gate the tail latency:
+``latency_p99_s`` must not exceed ``--max-p99-ratio`` (default 1.5) ×
+the recorded baseline — same-platform only, like the rate floor
+(latency is a property of the machine the row was recorded on).
+
 Exit 0 on pass; exit 1 with one line per violation.
 """
 
@@ -36,8 +42,8 @@ from typing import List
 def _load_row(path: str) -> dict:
     with open(path) as f:
         obj = json.load(f)
-    if obj.get("kind") == "swarm_lookup_trace":      # trace artifact
-        obj = obj["bench"]
+    if obj.get("kind") in ("swarm_lookup_trace", "swarm_serve_trace"):
+        obj = obj["bench"]                           # ...artifacts
     if "value" not in obj or "metric" not in obj:
         raise ValueError(f"{path}: no BENCH row found (need "
                          f"'metric'/'value' or a trace artifact)")
@@ -45,7 +51,8 @@ def _load_row(path: str) -> dict:
 
 
 def check_bench_rows(cur: dict, base: dict,
-                     min_ratio: float = 0.95) -> List[str]:
+                     min_ratio: float = 0.95,
+                     max_p99_ratio: float = 1.5) -> List[str]:
     """All violations of ``cur`` against ``base`` (empty = pass)."""
     errs: List[str] = []
     if cur.get("metric") != base.get("metric"):
@@ -60,6 +67,17 @@ def check_bench_rows(cur: dict, base: dict,
                 f"{cur['metric']} {cur['value']} below {min_ratio:.0%} "
                 f"of recorded baseline {base['value']} "
                 f"(floor {floor:.1f}, platform {cur.get('platform')})")
+        # Serve rows carry a tail-latency SLO leg: p99 is as
+        # load-bearing as the rate — a serve engine that got "faster"
+        # by queueing the tail must not gate green.
+        p_cur, p_base = cur.get("latency_p99_s"), base.get(
+            "latency_p99_s")
+        if p_cur is not None and p_base is not None \
+                and p_cur > max_p99_ratio * p_base:
+            errs.append(
+                f"latency_p99_s {p_cur} above {max_p99_ratio:.1f}x "
+                f"recorded baseline {p_base} (ceiling "
+                f"{max_p99_ratio * p_base:.4f}s)")
     else:
         print(f"check_bench: rate comparison SKIPPED — platform "
               f"{cur.get('platform')!r} vs baseline "
@@ -89,6 +107,7 @@ def main(argv=None) -> int:
     ap.add_argument("current")
     ap.add_argument("baseline")
     ap.add_argument("--min-ratio", type=float, default=0.95)
+    ap.add_argument("--max-p99-ratio", type=float, default=1.5)
     args = ap.parse_args(argv)
     try:
         cur = _load_row(args.current)
@@ -96,7 +115,8 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"check_bench: {e}")
         return 1
-    errs = check_bench_rows(cur, base, args.min_ratio)
+    errs = check_bench_rows(cur, base, args.min_ratio,
+                            args.max_p99_ratio)
     if errs:
         for e in errs:
             print(f"check_bench: {e}")

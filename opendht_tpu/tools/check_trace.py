@@ -10,6 +10,14 @@ violation otherwise.
 
     python -m opendht_tpu.tools.check_trace /tmp/trace.json
     python -m opendht_tpu.tools.check_trace /tmp/ledger.json
+    python -m opendht_tpu.tools.check_trace /tmp/serve.json
+
+``swarm_serve_trace`` artifacts (``bench.py --mode serve
+--serve-out``) get the serve-plane checks: lifecycle conservation
+(admitted == completed + in-flight), non-negative latencies, the
+latency histogram agreeing with the bench row's request count, and
+every reported quantile falling inside the histogram bucket that holds
+it (see :func:`check_serve_obj`).
 
 ``cost_ledger`` artifacts (``bench.py --ledger-out``) get the cost
 checks instead: round sub-phase rows must sum to the bench's measured
@@ -277,6 +285,138 @@ def check_ledger_obj(obj: dict) -> List[str]:
     return errs
 
 
+# Quantiles a serve artifact must report, with the bench-row field
+# they land in.
+SERVE_QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99),
+                   ("p999", 0.999))
+
+
+def check_serve_obj(obj: dict) -> List[str]:
+    """All violations found in a loaded serve artifact (empty = pass).
+
+    The serve gate's contract: per-request lifecycle must CONSERVE
+    (``admitted == completed + in_flight + expired``), latencies
+    must be non-negative, the latency histogram must agree with the
+    bench row's request count, and every reported quantile must fall
+    inside the histogram bucket that holds that quantile — a p99 the
+    recorded distribution cannot produce is a fabricated SLO.
+    """
+    errs: List[str] = []
+    for field in ("kind", "bench", "lifecycle", "latency_histogram",
+                  "latency_quantiles_s"):
+        if field not in obj:
+            errs.append(f"missing top-level field {field!r}")
+    if errs:
+        return errs
+    bench, life = obj["bench"], obj["lifecycle"]
+    hist, quants = obj["latency_histogram"], obj["latency_quantiles_s"]
+
+    admitted = life.get("admitted")
+    completed = life.get("completed")
+    in_flight = life.get("in_flight")
+    expired = life.get("expired", 0)
+    never = life.get("never_admitted", 0)
+    for name, v in (("admitted", admitted), ("completed", completed),
+                    ("in_flight", in_flight), ("expired", expired),
+                    ("never_admitted", never)):
+        if not (_num(v) and v >= 0):
+            errs.append(f"lifecycle {name} invalid: {v!r}")
+    if errs:
+        return errs
+    if admitted != completed + in_flight + expired:
+        errs.append(f"lifecycle does not conserve: admitted {admitted} "
+                    f"!= completed {completed} + in_flight {in_flight} "
+                    f"+ expired {expired}")
+    if completed == 0:
+        errs.append("no request completed — nothing to stand behind")
+
+    bounds = hist.get("bounds") or []
+    counts = hist.get("counts") or []
+    if len(counts) != len(bounds) + 1:
+        errs.append(f"latency histogram has {len(counts)} counts for "
+                    f"{len(bounds)} bounds (+overflow expected)")
+        return errs
+    if any(c < 0 for c in counts):
+        errs.append(f"latency histogram counts negative: {counts}")
+    if any(b <= 0 for b in bounds) or \
+            any(b >= c for b, c in zip(bounds, bounds[1:])):
+        errs.append(f"latency histogram bounds not positive-increasing:"
+                    f" {bounds}")
+    if sum(counts) != completed:
+        errs.append(f"latency histogram holds {sum(counts)} "
+                    f"observations but {completed} requests completed")
+    if _num(hist.get("sum")) and hist["sum"] < 0:
+        errs.append(f"latency histogram sum negative: {hist['sum']}")
+
+    # Reported quantiles: non-negative, monotone across q, and inside
+    # the bucket the recorded distribution puts that quantile in.
+    # The bucket walk reuses the REAL estimator
+    # (utils.metrics.Histogram — the class the bench derived the
+    # quantiles from), not a local re-implementation that could
+    # silently diverge from it.
+    from ..utils.metrics import Histogram
+    hist_obj = None
+    hist_ok = (bounds and not any(c < 0 for c in counts)
+               and sum(counts) > 0
+               and all(b > 0 for b in bounds)
+               and all(b < c for b, c in zip(bounds, bounds[1:])))
+    if hist_ok:
+        hist_obj = Histogram("serve_check", "", buckets=bounds)
+        hist_obj.observe_bulk(counts, 0.0)
+    prev = -1.0
+    # Zero-completed artifacts already failed above; walking quantiles
+    # against an empty distribution would only bury that diagnosis
+    # under nonsense (nan, nan] bucket lines.
+    for name, q in SERVE_QUANTILES if completed else ():
+        v = quants.get(name)
+        if not (_num(v) and v >= 0):
+            errs.append(f"latency quantile {name} invalid: {v!r}")
+            continue
+        if v < prev - 1e-12:
+            errs.append(f"latency quantiles not monotone at {name}: "
+                        f"{v} < {prev}")
+        prev = v
+        if hist_obj is None:
+            continue
+        lo, hi = hist_obj.bucket_bounds_of_quantile(q)
+        if not (lo - 1e-9 <= v <= hi + 1e-9):
+            errs.append(f"latency {name} {v:.6f}s outside its "
+                        f"histogram bucket ({lo:.6f}, {hi:.6f}]")
+        # The bench-row copy of this quantile is what check_bench
+        # gates (latency_p99_s ceiling) — a row field diverging from
+        # the histogram-consistent value is a fabricated SLO.
+        row_v = bench.get(f"latency_{name}_s")
+        if row_v is not None and (not _num(row_v)
+                                  or abs(row_v - v) > 1e-6):
+            errs.append(f"bench latency_{name}_s {row_v!r} != artifact "
+                        f"quantile {v} (the gated field must match the "
+                        f"histogram-derived one)")
+
+    # Bench-row consistency: the row the artifact rides must agree with
+    # the lifecycle plane it claims to summarize.
+    if bench.get("completed") is not None \
+            and bench["completed"] != completed:
+        errs.append(f"bench row completed {bench['completed']} != "
+                    f"lifecycle completed {completed}")
+    rate = bench.get("value")
+    el = bench.get("elapsed_s")
+    if _num(rate) and _num(el) and el > 0:
+        want = completed / el
+        if abs(rate - want) > max(0.02 * want, 0.5):
+            errs.append(f"bench sustained rate {rate} inconsistent "
+                        f"with completed/elapsed = {want:.1f}")
+    df = bench.get("done_frac")
+    if _num(df) and admitted:
+        want_df = completed / (admitted + never)
+        if abs(df - want_df) > 1e-6:
+            errs.append(f"bench done_frac {df} != completed/offered "
+                        f"{want_df:.6f}")
+    occ = bench.get("slot_occupancy_frac")
+    if occ is not None and not (_num(occ) and 0.0 <= occ <= 1.0):
+        errs.append(f"slot_occupancy_frac not a fraction: {occ!r}")
+    return errs
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) != 1:
@@ -289,6 +429,17 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"check_trace: cannot load {path}: {e}")
         return 1
+    if obj.get("kind") == "swarm_serve_trace":
+        errs = check_serve_obj(obj)
+        if errs:
+            for e in errs:
+                print(f"check_trace: {e}")
+            return 1
+        life, q = obj["lifecycle"], obj["latency_quantiles_s"]
+        print(f"check_trace: serve OK — {life['completed']} completed "
+              f"({life['in_flight']} in flight), p50 "
+              f"{q['p50'] * 1e3:.1f} ms, p99 {q['p99'] * 1e3:.1f} ms")
+        return 0
     if obj.get("kind") == "cost_ledger":
         errs = check_ledger_obj(obj)
         if errs:

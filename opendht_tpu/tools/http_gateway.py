@@ -9,6 +9,13 @@ Twisted-based gateway in the reference harness).
 Keys are free-form strings (SHA-1 hashed) or 40-char hex infohashes.
 ``metrics`` and ``stats.json`` are reserved paths; a DHT key with one
 of those literal names must be queried by its 40-char hex form.
+
+Every proxied DHT request is timed end-to-end (HTTP arrival →
+callback completion) into the per-request latency plane
+(``opendht_tpu.obs.latency.LatencyPlane``): ``/metrics`` exposes
+``dht_gateway_request_latency_seconds{op="get"|"put"}`` plus the SLO
+gauge set (target, violation ratio, error-budget burn rate) — the
+host-path twin of the serve bench's gauges, tunable with ``--slo-ms``.
 """
 
 from __future__ import annotations
@@ -18,9 +25,11 @@ import base64
 import json
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..core.value import Value
+from ..obs.latency import LatencyPlane
 from ..utils.infohash import InfoHash
 from ..utils.sockaddr import AF_INET, AF_INET6
 from .common import add_common_args, start_node
@@ -44,7 +53,11 @@ def node_stats_json(node) -> dict:
     }
 
 
-def make_handler(node):
+def make_handler(node, latency: LatencyPlane | None = None):
+    if latency is None:
+        latency = LatencyPlane(node.metrics, prefix="dht_gateway_request",
+                               label_names=("op",))
+
     class Handler(BaseHTTPRequestHandler):
         def _reply(self, code: int, obj) -> None:
             body = json.dumps(obj).encode()
@@ -91,6 +104,7 @@ def make_handler(node):
             if not key:
                 self._reply(400, {"error": "missing key"})
                 return
+            t0 = time.perf_counter()
             done = threading.Event()
             vals = []
 
@@ -100,6 +114,7 @@ def make_handler(node):
 
             node.get(_h(key), gcb, lambda ok, nodes: done.set())
             done.wait(timeout=30)
+            latency.observe(time.perf_counter() - t0, op="get")
             self._reply(200, [
                 {"id": f"{v.id:016x}", "type": v.type,
                  "data": base64.b64encode(v.data).decode(),
@@ -113,6 +128,7 @@ def make_handler(node):
             if not key or not data:
                 self._reply(400, {"error": "missing key or body"})
                 return
+            t0 = time.perf_counter()
             done = threading.Event()
             res = {}
 
@@ -122,6 +138,7 @@ def make_handler(node):
 
             node.put(_h(key), Value(data), dcb)
             done.wait(timeout=30)
+            latency.observe(time.perf_counter() - t0, op="put")
             self._reply(200 if res.get("ok") else 502,
                         {"ok": res.get("ok", False)})
 
@@ -135,10 +152,18 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="http_gateway", description=__doc__)
     add_common_args(ap)
     ap.add_argument("--http-port", type=int, default=8080)
+    ap.add_argument("--slo-ms", type=float, default=250.0,
+                    help="per-request latency SLO target for the "
+                         "gateway gauge set (milliseconds)")
     args = ap.parse_args(argv)
+    if args.slo_ms <= 0:
+        ap.error(f"--slo-ms must be > 0, got {args.slo_ms}")
     node = start_node(args)
+    latency = LatencyPlane(node.metrics, prefix="dht_gateway_request",
+                           label_names=("op",),
+                           slo_target_s=args.slo_ms / 1e3)
     srv = ThreadingHTTPServer(("127.0.0.1", args.http_port),
-                              make_handler(node))
+                              make_handler(node, latency))
     print(f"HTTP gateway on 127.0.0.1:{args.http_port} "
           f"(DHT port {node.get_bound_port()})")
     try:

@@ -186,6 +186,53 @@ class Histogram(Metric):
             return sorted((k, (list(c), s, n))
                           for k, (c, s, n) in self._h.items())
 
+    def quantile(self, q: float, **labels) -> float:
+        """Bucket-based quantile estimate (Prometheus
+        ``histogram_quantile`` semantics): find the bucket the q-th
+        observation falls in and interpolate LINEARLY inside it, with
+        the first bucket's lower bound taken as 0.  Returns ``nan``
+        with no observations; quantiles landing in the ``+Inf``
+        overflow bucket clamp to the largest finite bound (past it
+        there is nothing to interpolate against)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        key = self._key(labels)
+        with self._lock:
+            counts, _total, n = self._h.get(
+                key, ([0] * (len(self.buckets) + 1), 0.0, 0))
+            counts = list(counts)
+        if n == 0:
+            return float("nan")
+        target = q * n
+        for i, b in enumerate(self.buckets):
+            if counts[i] >= target:
+                lo = self.buckets[i - 1] if i else 0.0
+                prev = counts[i - 1] if i else 0
+                width = counts[i] - prev
+                if width <= 0:
+                    return float(b)
+                return float(lo + (b - lo) * (target - prev) / width)
+        return float(self.buckets[-1])
+
+    def bucket_bounds_of_quantile(self, q: float, **labels
+                                  ) -> Tuple[float, float]:
+        """``(lo, hi]`` bounds of the bucket holding the q-th
+        observation (``hi = inf`` for the overflow bucket) — what a
+        checker needs to prove a reported quantile is consistent with
+        the recorded distribution."""
+        key = self._key(labels)
+        with self._lock:
+            counts, _total, n = self._h.get(
+                key, ([0] * (len(self.buckets) + 1), 0.0, 0))
+            counts = list(counts)
+        if n == 0:
+            return (float("nan"), float("nan"))
+        target = q * n
+        for i, b in enumerate(self.buckets):
+            if counts[i] >= target:
+                return (self.buckets[i - 1] if i else 0.0, float(b))
+        return (float(self.buckets[-1]), float("inf"))
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]

@@ -395,3 +395,141 @@ class TestCheckTrace:
         assert main([str(p)]) == 0
         p.write_text("{not json")
         assert main([str(p)]) == 1
+
+
+class TestHistogramQuantile:
+    """Bucket-based quantile estimator (round-11 satellite): linear
+    interpolation inside the holding bucket, Prometheus
+    histogram_quantile semantics."""
+
+    def test_interpolation_within_bucket(self):
+        h = Histogram("h", "", buckets=(1.0, 2.0, 4.0))
+        # 10 observations all in (1, 2]: the q-th quantile walks the
+        # bucket linearly from its lower bound.
+        for _ in range(10):
+            h.observe(1.5)
+        assert h.quantile(0.5) == pytest.approx(1.5)
+        assert h.quantile(1.0) == pytest.approx(2.0)
+        assert h.quantile(0.1) == pytest.approx(1.1)
+
+    def test_first_bucket_interpolates_from_zero(self):
+        h = Histogram("h", "", buckets=(10.0, 20.0))
+        for _ in range(4):
+            h.observe(3.0)
+        assert h.quantile(0.5) == pytest.approx(5.0)
+
+    def test_multi_bucket_split(self):
+        h = Histogram("h", "", buckets=(1.0, 2.0, 4.0))
+        for _ in range(5):
+            h.observe(0.5)        # bucket (0, 1]
+        for _ in range(5):
+            h.observe(3.0)        # bucket (2, 4]
+        # p25 (target 2.5 of 10) sits mid-first-bucket; p75 (target
+        # 7.5) sits halfway into the (2, 4] bucket.
+        assert h.quantile(0.25) == pytest.approx(0.5)
+        assert h.quantile(0.75) == pytest.approx(3.0)
+
+    def test_overflow_clamps_to_last_bound(self):
+        h = Histogram("h", "", buckets=(1.0, 2.0))
+        h.observe(100.0)
+        assert h.quantile(0.99) == 2.0
+
+    def test_empty_is_nan(self):
+        import math
+        h = Histogram("h", "", buckets=(1.0,))
+        assert math.isnan(h.quantile(0.5))
+
+    def test_rejects_out_of_range_q(self):
+        h = Histogram("h", "", buckets=(1.0,))
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_bucket_bounds_of_quantile(self):
+        h = Histogram("h", "", buckets=(1.0, 2.0, 4.0))
+        for _ in range(10):
+            h.observe(1.5)
+        assert h.bucket_bounds_of_quantile(0.5) == (1.0, 2.0)
+        h.observe(50.0)
+        lo, hi = h.bucket_bounds_of_quantile(0.9999)
+        assert lo == 4.0 and hi == float("inf")
+
+    def test_labelled_series_quantile(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "", ("klass",), buckets=(1.0, 2.0))
+        h.observe(0.5, klass="hot")
+        h.observe(1.5, klass="cold")
+        assert h.quantile(0.5, klass="hot") <= 1.0
+        assert h.quantile(0.5, klass="cold") > 1.0
+
+
+class TestLatencyPlane:
+    def test_slo_gauges_and_burn_rate(self):
+        from opendht_tpu.obs.latency import LatencyPlane
+        reg = MetricsRegistry()
+        pl = LatencyPlane(reg, prefix="dht_serve_request",
+                          label_names=("klass",), slo_target_s=0.1,
+                          slo_objective=0.99)
+        for v in (0.01, 0.05, 0.09, 0.2):      # 1 of 4 over target
+            pl.observe(v, klass="all")
+        assert pl.violation_ratio == pytest.approx(0.25)
+        # burn rate = violation / (1 - objective) = 0.25 / 0.01
+        assert pl.burn_rate == pytest.approx(25.0)
+        text = reg.render_prometheus()
+        assert "dht_serve_request_latency_seconds_bucket" in text
+        assert "dht_serve_request_slo_target_seconds 0.1" in text
+        assert "dht_serve_request_slo_violation_ratio 0.25" in text
+        assert "dht_serve_request_slo_error_budget_burn_rate" in text
+        assert reg.get(
+            "dht_serve_request_slo_error_budget_burn_rate"
+        ).get() == pytest.approx(25.0)
+
+    def test_rejects_bad_config_and_values(self):
+        from opendht_tpu.obs.latency import LatencyPlane
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            LatencyPlane(reg, slo_target_s=0.0)
+        with pytest.raises(ValueError):
+            LatencyPlane(reg, prefix="p2", slo_objective=1.0)
+        pl = LatencyPlane(reg, prefix="p3")
+        with pytest.raises(ValueError):
+            pl.observe(-1.0)
+
+    def test_gateway_handler_registers_latency_plane(self):
+        # make_handler must build the gateway latency plane on the
+        # node's registry even when main() didn't (embedded use).
+        from opendht_tpu.tools.http_gateway import make_handler
+
+        class _N:
+            metrics = MetricsRegistry()
+
+        make_handler(_N())
+        text = _N.metrics.render_prometheus()
+        assert "dht_gateway_request_slo_target_seconds" in text
+
+
+class TestHopHistogramPublish:
+    def test_device_hop_histogram_lands_in_registry(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from opendht_tpu.models.swarm import hop_histogram
+        from opendht_tpu.obs.latency import publish_hop_histogram
+        hops = jnp.asarray([0, 1, 1, 2, 4, 9], jnp.int32)
+        counts = np.asarray(hop_histogram(hops, 8))
+        reg = MetricsRegistry()
+        h = publish_hop_histogram(reg, counts)
+        text = reg.render_prometheus()
+        assert "# TYPE dht_lookup_hops histogram" in text
+        assert 'dht_lookup_hops_bucket{le="0"} 1' in text
+        assert 'dht_lookup_hops_bucket{le="+Inf"} 6' in text
+        assert "dht_lookup_hops_count 6" in text
+        # A REAL histogram: quantile-able.
+        assert 0.0 <= h.quantile(0.5) <= 2.0
+        # Hop total with the overflow bin floored at max_steps
+        # (0+1+1+2+4 + min(9, 8) = 16).
+        assert "dht_lookup_hops_sum 16" in text
+
+    def test_rejects_degenerate(self):
+        from opendht_tpu.obs.latency import publish_hop_histogram
+        with pytest.raises(ValueError):
+            publish_hop_histogram(MetricsRegistry(), [3])

@@ -1,0 +1,441 @@
+"""Per-request latency plane + open-loop serve engine.
+
+Two contracts, mirroring ``tests/test_compaction.py``'s seed-identity
+pattern:
+
+* lifecycle tracking (``LookupState.admitted_round``/
+  ``completed_round``) is a PURE OBSERVER — results, strikes and
+  traces are bit-identical with tracking on or off across the plain,
+  traced, chaos and sharded engines;
+* a closed-loop replay through the serve engine's admit/step path is
+  bit-identical to the batch engine for the same request set — slot
+  recycling changes scheduling, never per-request semantics.
+
+Plus the open-loop serve report's conservation/latency invariants, the
+overload guard, the sharded serve smoke, and the serve-artifact
+checker.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.models.serve import (
+    ServeEngine,
+    ServeOverloadError,
+    ShardedServeEngine,
+    closed_loop_replay,
+    poisson_zipf_events,
+    serve_open_loop,
+)
+from opendht_tpu.models.swarm import (
+    LookupFaults,
+    LookupTrace,
+    SwarmConfig,
+    build_swarm,
+    chaos_lookup,
+    churn,
+    corrupt_swarm,
+    lookup,
+    traced_lookup,
+)
+
+CFG = SwarmConfig.for_nodes(2048)
+L = 512
+
+
+@pytest.fixture(scope="module")
+def swarm():
+    return build_swarm(jax.random.PRNGKey(7), CFG)
+
+
+@pytest.fixture(scope="module")
+def churned(swarm):
+    # Unhealed 25 % death: the long-tail regime, several ladder steps —
+    # exactly the state the compaction-equivalence suite uses, so the
+    # lifecycle rows are proven to ride the repack correctly.
+    return churn(swarm, jax.random.PRNGKey(9), 0.25, CFG)
+
+
+@pytest.fixture(scope="module")
+def targets():
+    return jax.random.bits(jax.random.PRNGKey(1), (L, 5), jnp.uint32)
+
+
+def _res_equal(a, b):
+    return (np.array_equal(np.asarray(a.found), np.asarray(b.found))
+            and np.array_equal(np.asarray(a.hops), np.asarray(b.hops))
+            and np.array_equal(np.asarray(a.done), np.asarray(b.done)))
+
+
+class TestLifecycleBitIdentity:
+    def test_plain_on_off(self, churned, targets):
+        stats = {}
+        r_on = lookup(churned, CFG, targets, jax.random.PRNGKey(2),
+                      track_lifecycle=True, stats=stats)
+        r_off = lookup(churned, CFG, targets, jax.random.PRNGKey(2))
+        assert _res_equal(r_on, r_off)
+        adm = np.asarray(stats["admitted_round"])
+        com = np.asarray(stats["completed_round"])
+        done = np.asarray(r_on.done)
+        hops = np.asarray(r_on.hops)
+        assert (adm == 0).all()         # batch: everything admitted @0
+        assert (com[done] >= 0).all()
+        assert (com[~done] == -1).all()
+        # A row's done bit flips in the round that increments its last
+        # hop (or the exhaustion round right after) — completion can
+        # never be stamped before the work that produced it.
+        assert (com[done] >= hops[done] - 1).all()
+
+    def test_plain_on_off_uncompacted(self, churned, targets):
+        r_on = lookup(churned, CFG, targets, jax.random.PRNGKey(2),
+                      compact=False, track_lifecycle=True, stats={})
+        r_off = lookup(churned, CFG, targets, jax.random.PRNGKey(2),
+                       compact=False)
+        assert _res_equal(r_on, r_off)
+
+    def test_traced_on_off_including_trace(self, churned, targets):
+        r_on, t_on = traced_lookup(churned, CFG, targets,
+                                   jax.random.PRNGKey(2),
+                                   track_lifecycle=True)
+        r_off, t_off = traced_lookup(churned, CFG, targets,
+                                     jax.random.PRNGKey(2))
+        assert _res_equal(r_on, r_off)
+        for name, a, b in zip(LookupTrace._fields, t_on, t_off):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), name
+
+    def test_chaos_on_off(self, churned, targets):
+        """The acceptance combo: churn + Byzantine + reply loss,
+        defended — results AND strike state bit-equal with the
+        lifecycle plane riding the chaos carry."""
+        bz = corrupt_swarm(churned, jax.random.PRNGKey(3), 0.10, CFG)
+        f = LookupFaults(drop_frac=0.15, seed=6)
+        stats = {}
+        r_on, s_on = chaos_lookup(bz, CFG, targets,
+                                  jax.random.PRNGKey(4), f,
+                                  track_lifecycle=True, stats=stats)
+        r_off, s_off = chaos_lookup(bz, CFG, targets,
+                                    jax.random.PRNGKey(4), f)
+        assert _res_equal(r_on, r_off)
+        assert np.array_equal(np.asarray(s_on), np.asarray(s_off))
+        # The chaos engine surfaces the lifecycle rows like lookup().
+        com = np.asarray(stats["completed_round"])
+        done = np.asarray(r_on.done)
+        assert (com[done] >= 0).all()
+
+
+class TestShardedLifecycle:
+    @pytest.fixture(scope="class")
+    def mesh8(self):
+        from opendht_tpu.parallel import make_mesh
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        return make_mesh(8)
+
+    @pytest.fixture(scope="class")
+    def setup(self, mesh8):
+        cfg = SwarmConfig.for_nodes(8192)
+        sw = build_swarm(jax.random.PRNGKey(0), cfg)
+        sw = churn(sw, jax.random.PRNGKey(9), 0.3, cfg)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (4096, 5),
+                             jnp.uint32)
+        return cfg, sw, tg
+
+    def test_sharded_on_off(self, mesh8, setup):
+        from opendht_tpu.parallel.sharded import sharded_lookup
+        cfg, sw, tg = setup
+        r_off = sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2),
+                               mesh8, 2.0, compact=True)
+        stats = {}
+        r_on = sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2),
+                              mesh8, 2.0, compact=True,
+                              track_lifecycle=True, stats=stats)
+        assert _res_equal(r_on, r_off)
+        com = np.asarray(stats["completed_round"])
+        done = np.asarray(r_on.done)
+        assert (com[done] >= 0).all()
+
+    def test_sharded_track_forces_burst_formulation(self, mesh8,
+                                                    setup):
+        from opendht_tpu.parallel.sharded import sharded_lookup
+        cfg, sw, tg = setup
+        stats = {}
+        sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2), mesh8, 2.0,
+                       track_lifecycle=True, stats=stats)
+        assert stats["formulation"] == "burst-compacted"
+
+    def test_sharded_track_rejects_rebalance(self, mesh8, setup):
+        from opendht_tpu.parallel.sharded import sharded_lookup
+        cfg, sw, tg = setup
+        with pytest.raises(ValueError, match="rebalance"):
+            sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2), mesh8,
+                           2.0, track_lifecycle=True, rebalance=True)
+
+    def test_sharded_serve_smoke(self, mesh8, setup):
+        """Open-loop serve on the 8-dev mesh: the routed step advances
+        recycled slots; conservation and non-negative latency hold."""
+        cfg, sw, tg = setup
+        ts, keys, klass = poisson_zipf_events(
+            rate=400, duration=0.4, key_pool=64, zipf_s=1.1, seed=5)
+        eng = ShardedServeEngine(sw, cfg, slots=256, mesh=mesh8,
+                                 capacity_factor=2.0, admit_cap=64)
+        rep = serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                              klass=klass)
+        assert rep["admitted"] == rep["completed"] + rep["in_flight"]
+        assert rep["completed"] > 0
+        assert (rep["latency_s"] >= 0).all()
+
+    def test_sharded_serve_rejects_non_mesh_divisible(self, mesh8,
+                                                      setup):
+        cfg, sw, _ = setup
+        with pytest.raises(ValueError, match="divide"):
+            ShardedServeEngine(sw, cfg, slots=250, mesh=mesh8)
+
+
+class TestClosedLoopReplay:
+    def test_bit_identical_to_batch_engine(self, churned, targets):
+        """The satellite's core claim: a closed-loop replay through the
+        serve engine (admit into slots, recycled-width rounds) produces
+        bit-identical found/hops/done to the batch engine for the same
+        request set and key."""
+        r_batch = lookup(churned, CFG, targets, jax.random.PRNGKey(2))
+        r_serve, st = closed_loop_replay(churned, CFG, targets,
+                                         jax.random.PRNGKey(2))
+        assert _res_equal(r_serve, r_batch)
+        # Lifecycle rows are live on the replayed state.
+        adm = np.asarray(st.admitted_round)
+        com = np.asarray(st.completed_round)
+        done = np.asarray(st.done)
+        assert (adm == 0).all()
+        assert (com[done] >= 0).all()
+
+    def test_healthy_swarm_replay(self, swarm, targets):
+        r_batch = lookup(swarm, CFG, targets, jax.random.PRNGKey(5))
+        r_serve, _ = closed_loop_replay(swarm, CFG, targets,
+                                        jax.random.PRNGKey(5))
+        assert _res_equal(r_serve, r_batch)
+
+
+class TestOpenLoopServe:
+    def test_report_invariants(self, swarm):
+        ts, keys, klass = poisson_zipf_events(
+            rate=2000, duration=0.5, key_pool=256, zipf_s=1.1, seed=5)
+        eng = ServeEngine(swarm, CFG, slots=256, admit_cap=128)
+        rep = serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                              klass=klass)
+        assert rep["admitted"] == rep["completed"] + rep["in_flight"]
+        assert rep["completed"] > 0
+        lat = rep["latency_s"]
+        assert (lat >= 0).all()
+        assert len(lat) == rep["completed"]
+        assert rep["found_nonempty"].all()
+        assert 0.0 <= rep["slot_occupancy_frac"] <= 1.0
+        assert rep["rounds"] >= 1
+        # Service rounds are positive and bounded by the engine cap.
+        assert (rep["service_rounds"] >= 1).all()
+        assert (rep["service_rounds"] <= CFG.max_steps * 5).all()
+        # Both request classes survived into the per-request records.
+        assert set(np.unique(rep["klass"])) <= {"hot", "cold"}
+
+    def test_slot_recycling_actually_recycles(self, swarm):
+        """More requests than slots MUST flow through recycled slots:
+        completion count exceeding the slot count proves mid-flight
+        re-admission (the tentpole's mechanism)."""
+        ts, keys, _ = poisson_zipf_events(
+            rate=1000, duration=0.5, key_pool=128, zipf_s=0.0, seed=6)
+        assert len(ts) > 64
+        eng = ServeEngine(swarm, CFG, slots=64, admit_cap=64)
+        # Generous overload bound: this test proves recycling, not
+        # capacity — queueing on a slow CI machine must not flake it.
+        rep = serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                              overload_queue_factor=64)
+        assert rep["completed"] > 64
+        assert rep["admitted"] == rep["completed"] + rep["in_flight"]
+
+    def test_stuck_requests_expire_and_slots_recycle(self, swarm):
+        """A request that never converges must not squat on its slot:
+        past cfg.max_steps rounds it is retired (booked as expired,
+        never as a latency sample), the slot recycles, and the run
+        terminates WITHOUT a spurious overload — proven with a stubbed
+        step that never completes anything."""
+        ts = np.zeros(40)
+        keys = np.zeros((40, 5), np.uint32)
+        eng = ServeEngine(swarm, CFG, slots=16, admit_cap=16)
+        eng.step = lambda st, rnd: st          # nothing ever finishes
+        rep = serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                              overload_queue_factor=64)
+        assert rep["completed"] == 0
+        assert rep["expired"] == rep["admitted"] == 40
+        assert rep["in_flight"] == 0
+        assert len(rep["latency_s"]) == 0
+
+    def test_overload_raises_clear_error(self, swarm):
+        # 8 slots against a firehose: the queue passes the overload
+        # bound within the first iterations.
+        ts = np.linspace(0.0, 0.01, 2000)
+        keys = jax.random.bits(jax.random.PRNGKey(1), (2000, 5),
+                               jnp.uint32)
+        eng = ServeEngine(swarm, CFG, slots=8, admit_cap=8)
+        with pytest.raises(ServeOverloadError, match="arrival rate"):
+            serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                            overload_queue_factor=8)
+
+    def test_event_generator_validates(self):
+        with pytest.raises(ValueError):
+            poisson_zipf_events(rate=0, duration=1, key_pool=8,
+                                zipf_s=1.0)
+        with pytest.raises(ValueError):
+            poisson_zipf_events(rate=100, duration=-1, key_pool=8,
+                                zipf_s=1.0)
+
+    def test_event_generator_shapes_and_classes(self):
+        ts, keys, klass = poisson_zipf_events(
+            rate=500, duration=1.0, key_pool=100, zipf_s=1.2, seed=3)
+        assert (np.diff(ts) >= 0).all()
+        assert ts[-1] < 1.0
+        assert keys.shape == (len(ts), 5)
+        assert set(np.unique(klass)) <= {"hot", "cold"}
+        # Zipf head concentrates: the hot class (top 1% of the pool)
+        # must be heavily over-represented vs its 1% key share.
+        assert (klass == "hot").mean() > 0.05
+
+
+class TestServeChecker:
+    def _artifact(self):
+        # A minimal self-consistent serve artifact (the shape
+        # bench.py --mode serve --serve-out writes).  The quantiles are
+        # the exact Histogram.quantile values for this histogram, and
+        # the bench row's gated latency_p99_s carries the SAME value —
+        # the checker rejects any divergence between the two.
+        bounds = [0.001, 0.01, 0.1, 1.0]
+        counts = [10, 60, 25, 5, 0]       # 100 completed, none >1s
+        return {
+            "kind": "swarm_serve_trace",
+            "bench": {
+                "metric": "swarm_serve_req_per_sec",
+                "value": 50.0,
+                "completed": 100,
+                "elapsed_s": 2.0,
+                "done_frac": 1.0,
+                "slot_occupancy_frac": 0.5,
+                "latency_p50_s": 0.007,
+                "latency_p99_s": 0.82,
+                "platform": "cpu",
+            },
+            "lifecycle": {"admitted": 100, "completed": 100,
+                          "in_flight": 0, "expired": 0,
+                          "never_admitted": 0},
+            "latency_histogram": {"bounds": bounds, "counts": counts,
+                                  "sum": 2.0, "count": 100},
+            "latency_quantiles_s": {"p50": 0.007, "p95": 0.1,
+                                    "p99": 0.82, "p999": 0.982},
+        }
+
+    def test_valid_artifact_passes(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        assert check_serve_obj(self._artifact()) == []
+
+    def test_conservation_violation_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        a["lifecycle"]["in_flight"] = 3
+        errs = check_serve_obj(a)
+        assert any("conserve" in e for e in errs), errs
+
+    def test_histogram_count_mismatch_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        a["latency_histogram"]["counts"][0] += 1
+        errs = check_serve_obj(a)
+        assert any("observations" in e for e in errs), errs
+
+    def test_quantile_outside_bucket_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        # p50 of this histogram lives in (0.001, 0.01]; claim 0.5s.
+        a["latency_quantiles_s"]["p50"] = 0.5
+        errs = check_serve_obj(a)
+        assert any("p50" in e and "bucket" in e for e in errs), errs
+
+    def test_expired_conservation(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        # 5 expired requests: conservation must include them (and the
+        # offered denominator of done_frac grows with them)...
+        a["lifecycle"]["admitted"] = 105
+        a["lifecycle"]["expired"] = 5
+        a["bench"]["done_frac"] = round(100 / 105, 6)
+        assert check_serve_obj(a) == []
+        # ...and a mismatch is still flagged.
+        a["lifecycle"]["expired"] = 4
+        errs = check_serve_obj(a)
+        assert any("conserve" in e for e in errs), errs
+
+    def test_bench_row_quantile_divergence_flagged(self):
+        """The field check_bench gates (bench.latency_p99_s) must
+        match the histogram-derived quantile — a fabricated SLO in the
+        row is rejected even when the artifact quantiles are sound."""
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        a["bench"]["latency_p99_s"] = 0.05
+        errs = check_serve_obj(a)
+        assert any("latency_p99_s" in e for e in errs), errs
+
+    def test_negative_quantile_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        a["latency_quantiles_s"]["p95"] = -0.1
+        errs = check_serve_obj(a)
+        assert any("p95" in e for e in errs), errs
+
+    def test_rate_inconsistency_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        a["bench"]["value"] = 500.0     # 100 completed / 2 s != 500
+        errs = check_serve_obj(a)
+        assert any("inconsistent" in e for e in errs), errs
+
+    def test_main_dispatches_serve_kind(self, tmp_path, capsys):
+        import json
+        from opendht_tpu.tools.check_trace import main
+        p = tmp_path / "serve.json"
+        p.write_text(json.dumps(self._artifact()))
+        assert main([str(p)]) == 0
+        assert "serve OK" in capsys.readouterr().out
+
+
+class TestServeBenchGate:
+    BASE = {"metric": "swarm_serve_req_per_sec", "value": 1000.0,
+            "platform": "cpu", "done_frac": 1.0,
+            "latency_p99_s": 0.5}
+
+    def test_rate_floor_and_p99_ceiling(self):
+        from opendht_tpu.tools.check_bench import check_bench_rows
+        base = self.BASE
+        assert check_bench_rows(dict(base, value=990.0), base) == []
+        errs = check_bench_rows(dict(base, value=900.0), base)
+        assert any("below 95%" in e for e in errs)
+        # Tail-latency ceiling: 1.5x the recorded p99.
+        errs = check_bench_rows(dict(base, latency_p99_s=0.80), base)
+        assert any("latency_p99_s" in e for e in errs)
+        assert check_bench_rows(dict(base, latency_p99_s=0.70),
+                                base) == []
+        # Cross-platform: both rate AND latency verdicts are skipped.
+        cross = dict(base, value=1.0, latency_p99_s=9.0,
+                     platform="tpu")
+        assert check_bench_rows(cross, base) == []
+
+    def test_loads_serve_artifact(self, tmp_path):
+        import json
+        from opendht_tpu.tools.check_bench import main
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps(self.BASE))
+        art = tmp_path / "serve.json"
+        art.write_text(json.dumps({
+            "kind": "swarm_serve_trace",
+            "bench": dict(self.BASE, value=1010.0),
+            "lifecycle": {}, "latency_histogram": {},
+            "latency_quantiles_s": {}}))
+        assert main([str(art), str(base)]) == 0
