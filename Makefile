@@ -60,6 +60,17 @@ bench:
 # in-flight), histogram⇄row consistency and bucket-derived quantiles;
 # check_bench gates sustained req/s (0.95x floor) and tail latency
 # (1.5x p99 ceiling) against the recorded BENCH_GATE_r07.json row.
+# The MONITOR legs (round 12): the one-shot crawl row gates a 0.99x
+# coverage floor against the recorded BENCH_GATE_r08.json (previously
+# the only bench mode with no regression gate); the small monitor leg
+# (16k nodes, 2 sweeps under kill 0.05 after the initial crawl) runs
+# the continuous incremental-crawl engine and its artifact must pass
+# check_trace (freshness conservation, detection lag within the
+# stated sweep-period bound, hop histogram inside the analytic-model
+# band — the repo's first model-based fidelity gate) and check_bench
+# (coverage floor + lag bound vs the recorded MONITOR_GATE_r08.json);
+# the checked-in 1M acceptance artifact MONITOR_r08.json is
+# re-validated so the committed record can never rot.
 gate: test
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	python -m pytest tests/test_merge_equivalence.py -q
@@ -73,6 +84,12 @@ gate: test
 	python bench.py --mode serve --nodes 16384 --arrival-rate 2000 --duration 3 --serve-slots 1024 --key-pool 1024 --serve-out /tmp/serve.json
 	python -m opendht_tpu.tools.check_trace /tmp/serve.json
 	python -m opendht_tpu.tools.check_bench /tmp/serve.json BENCH_GATE_r07.json
+	python bench.py --mode crawl --nodes 100000 > /tmp/crawl_row.json
+	python -m opendht_tpu.tools.check_bench /tmp/crawl_row.json BENCH_GATE_r08.json
+	python bench.py --mode monitor --nodes 16384 --sweeps 3 --kill-frac 0.05 --monitor-out /tmp/monitor.json
+	python -m opendht_tpu.tools.check_trace /tmp/monitor.json
+	python -m opendht_tpu.tools.check_bench /tmp/monitor.json MONITOR_GATE_r08.json
+	python -m opendht_tpu.tools.check_trace MONITOR_r08.json
 	python bench.py --mode chaos --nodes 16384 --puts 2048
 	python bench.py --mode chaos-lookup --nodes 16384 --lookups 4096 --recall-sample 256
 

@@ -10,6 +10,12 @@ alpha-select / merge / scatter-writeback against the fused round.
 compute- vs memory- vs issue-bound verdict.
 """
 
+from .health import (  # noqa: F401
+    SwarmHealthPlane,
+    analytic_hop_pmf,
+    hop_fidelity,
+    poisson_density_profile,
+)
 from .latency import (  # noqa: F401
     LatencyPlane,
     publish_hop_histogram,

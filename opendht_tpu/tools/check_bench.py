@@ -28,6 +28,14 @@ SERVE rows (``swarm_serve_req_per_sec`` — from ``--mode serve`` or its
 the recorded baseline — same-platform only, like the rate floor
 (latency is a property of the machine the row was recorded on).
 
+COVERAGE rows (``swarm_crawl_coverage`` / ``swarm_monitor_coverage`` —
+the crawl leg and ``--mode monitor``, incl. its
+``swarm_monitor_trace`` artifact) replace the rate floor with a
+QUALITY floor that gates on any platform: coverage must not drop below
+0.99 × the recorded value (the crawl row was the one bench mode with
+no regression gate), and a monitor row's measured ``detection_lag_max``
+must stay within the recorded row's stated sweep-period bound.
+
 Exit 0 on pass; exit 1 with one line per violation.
 """
 
@@ -42,12 +50,22 @@ from typing import List
 def _load_row(path: str) -> dict:
     with open(path) as f:
         obj = json.load(f)
-    if obj.get("kind") in ("swarm_lookup_trace", "swarm_serve_trace"):
+    if obj.get("kind") in ("swarm_lookup_trace", "swarm_serve_trace",
+                           "swarm_monitor_trace"):
         obj = obj["bench"]                           # ...artifacts
     if "value" not in obj or "metric" not in obj:
         raise ValueError(f"{path}: no BENCH row found (need "
                          f"'metric'/'value' or a trace artifact)")
     return obj
+
+
+# Coverage rows (the crawl leg and the monitor's steady-state
+# coverage) gate as QUALITY metrics: platform-independent (the crawl
+# is seed-deterministic arithmetic, not a rate), floored at
+# COVERAGE_MIN_RATIO x the recorded value — the ISSUE 8 contract that
+# closed the one bench mode with no regression gate.
+COVERAGE_METRICS = ("swarm_crawl_coverage", "swarm_monitor_coverage")
+COVERAGE_MIN_RATIO = 0.99
 
 
 def check_bench_rows(cur: dict, base: dict,
@@ -60,7 +78,25 @@ def check_bench_rows(cur: dict, base: dict,
                     f"baseline {base.get('metric')!r}")
         return errs
 
-    if cur.get("platform") == base.get("platform"):
+    if cur.get("metric") in COVERAGE_METRICS:
+        # Coverage is a fraction, not a machine rate: the floor gates
+        # on ANY platform, and the generic same-platform rate floor
+        # below would be both looser and semantically wrong for it.
+        floor = COVERAGE_MIN_RATIO * base["value"]
+        if cur["value"] < floor:
+            errs.append(
+                f"{cur['metric']} {cur['value']} below "
+                f"{COVERAGE_MIN_RATIO:.0%} of recorded baseline "
+                f"{base['value']} (floor {floor:.4f})")
+        # Monitor rows also carry the lag contract: detection must not
+        # exceed the RECORDED row's stated bound (the sweep period).
+        lag, lag_bound = cur.get("detection_lag_max"), base.get(
+            "detection_lag_bound_sweeps")
+        if lag is not None and lag_bound is not None \
+                and lag > lag_bound:
+            errs.append(f"detection_lag_max {lag} exceeds the "
+                        f"recorded sweep-period bound {lag_bound}")
+    elif cur.get("platform") == base.get("platform"):
         floor = min_ratio * base["value"]
         if cur["value"] < floor:
             errs.append(

@@ -11,6 +11,14 @@ violation otherwise.
     python -m opendht_tpu.tools.check_trace /tmp/trace.json
     python -m opendht_tpu.tools.check_trace /tmp/ledger.json
     python -m opendht_tpu.tools.check_trace /tmp/serve.json
+    python -m opendht_tpu.tools.check_trace MONITOR_r08.json
+
+``swarm_monitor_trace`` artifacts (``bench.py --mode monitor
+--monitor-out``) get the swarm-health checks: per-sweep freshness
+conservation, churn-detection lag within the scheduler's stated bound,
+and the measured hop histogram within the stated band of the analytic
+hop-count model — recomputed here from the swarm geometry, the repo's
+first MODEL-BASED fidelity gate (see :func:`check_monitor_obj`).
 
 ``swarm_serve_trace`` artifacts (``bench.py --mode serve
 --serve-out``) get the serve-plane checks: lifecycle conservation
@@ -417,6 +425,162 @@ def check_serve_obj(obj: dict) -> List[str]:
     return errs
 
 
+# Hard ceiling on the hop-fidelity band a monitor artifact may state:
+# the band is part of the recorded contract, but an artifact that
+# "passes" by declaring a band of 1.0 has gated nothing.
+MONITOR_MAX_BAND_TV = 0.25
+
+
+def check_monitor_obj(obj: dict) -> List[str]:
+    """All violations found in a loaded swarm-monitor artifact (empty
+    = pass).
+
+    The monitor gate's contract (ISSUE 8):
+
+    a. **freshness conservation** — per sweep, the tracked-alive
+       population must conserve exactly (``tracked_alive' ==
+       tracked_alive + newly_discovered + resurrected - newly_dead``),
+       probes must account (``probed_tracked == probed_seen +
+       probed_missed``), and a node is fresh iff this sweep saw it
+       (``nodes_fresh == nodes_seen``);
+    b. **detection lag** — every sweep's ``lag_max`` must sit within
+       the scheduler's stated bound, and the stated bound must equal
+       the one the config implies (``period + miss_limit - 1``);
+    c. **analytic hop fidelity** — the initial full-crawl hop
+       histogram must sit within the stated band of the analytic
+       model, RECOMPUTED here from the swarm geometry
+       (``obs.health.analytic_hop_pmf``) so the artifact cannot ship a
+       fabricated prediction; the band itself is capped at
+       :data:`MONITOR_MAX_BAND_TV`.
+    """
+    errs: List[str] = []
+    for field in ("kind", "bench", "monitor"):
+        if field not in obj:
+            errs.append(f"missing top-level field {field!r}")
+    if errs:
+        return errs
+    bench, mon = obj["bench"], obj["monitor"]
+    cfg = mon.get("config") or {}
+    sweeps = mon.get("sweeps") or []
+    if not sweeps:
+        errs.append("monitor block has no sweeps")
+        return errs
+    for knob in ("period", "miss_limit", "fresh_ttl", "depth",
+                 "detection_lag_bound_sweeps", "bucket_k", "alpha",
+                 "quorum"):
+        if not (_num(cfg.get(knob)) and cfg[knob] >= 0):
+            errs.append(f"monitor config {knob} invalid: "
+                        f"{cfg.get(knob)!r}")
+    if errs:
+        return errs
+
+    # (b) detection-lag bound: stated == derived, measured <= stated.
+    bound = cfg["detection_lag_bound_sweeps"]
+    want_bound = cfg["period"] + cfg["miss_limit"] - 1
+    if bound != want_bound:
+        errs.append(f"detection_lag_bound_sweeps {bound} != period + "
+                    f"miss_limit - 1 = {want_bound}")
+
+    count_fields = ("nodes_seen", "newly_discovered", "resurrected",
+                    "newly_dead", "tracked_alive", "covered",
+                    "actual_alive", "false_alive", "false_dead",
+                    "probed_tracked", "probed_seen", "probed_missed",
+                    "lag_sum", "lag_count", "nodes_fresh")
+    prev_alive = 0
+    for r in sweeps:
+        s = r.get("sweep", "?")
+        missing = [f for f in count_fields
+                   if not (_num(r.get(f)) and r[f] >= 0)]
+        if missing:
+            errs.append(f"sweep {s}: missing/negative counters "
+                        f"{missing}")
+            return errs
+        # (a) freshness conservation — exact identities of the fold.
+        want = (prev_alive + r["newly_discovered"] + r["resurrected"]
+                - r["newly_dead"])
+        if r["tracked_alive"] != want:
+            errs.append(
+                f"sweep {s}: tracked_alive {r['tracked_alive']} != "
+                f"prev + discovered + resurrected - dead = {want} "
+                f"(freshness does not conserve)")
+        if r["probed_tracked"] != r["probed_seen"] + r["probed_missed"]:
+            errs.append(
+                f"sweep {s}: probed_tracked {r['probed_tracked']} != "
+                f"probed_seen {r['probed_seen']} + probed_missed "
+                f"{r['probed_missed']}")
+        if r["nodes_fresh"] != r["nodes_seen"]:
+            errs.append(f"sweep {s}: nodes_fresh {r['nodes_fresh']} != "
+                        f"nodes_seen {r['nodes_seen']} — a node must "
+                        f"be fresh iff this sweep saw it")
+        if r["covered"] > min(r["tracked_alive"], r["actual_alive"]):
+            errs.append(f"sweep {s}: covered {r['covered']} exceeds "
+                        f"tracked/actual population")
+        cov = r.get("coverage")
+        want_cov = r["covered"] / max(1, r["actual_alive"])
+        if not (_num(cov) and abs(cov - want_cov) <= 1e-5):
+            errs.append(f"sweep {s}: coverage {cov!r} != covered/"
+                        f"actual_alive {want_cov:.6f}")
+        if r["lag_count"] > r["newly_dead"]:
+            errs.append(f"sweep {s}: lag_count {r['lag_count']} > "
+                        f"newly_dead {r['newly_dead']}")
+        if r["lag_count"] and not (_num(r.get("lag_max"))
+                                   and 0 <= r["lag_max"] <= bound):
+            errs.append(f"sweep {s}: lag_max {r.get('lag_max')!r} "
+                        f"outside [0, {bound}] — detection slower "
+                        f"than the stated sweep period")
+        prev_alive = r["tracked_alive"]
+
+    # (c) hop-histogram-vs-analytic-model fidelity, recomputed.
+    hist = mon.get("hop_histogram_initial")
+    n_alive = mon.get("initial_alive")
+    fid = mon.get("hop_fidelity") or {}
+    if not hist or not (_num(n_alive) and n_alive >= 2):
+        errs.append("monitor artifact lacks hop_histogram_initial/"
+                    "initial_alive — nothing to hold the model "
+                    "against")
+        return errs
+    band = fid.get("band_tv")
+    if not (_num(band) and 0 < band <= MONITOR_MAX_BAND_TV):
+        errs.append(f"hop_fidelity band_tv {band!r} missing or above "
+                    f"the {MONITOR_MAX_BAND_TV} ceiling")
+        return errs
+    from ..obs.health import HOP_MEDIAN_TOL, hop_fidelity
+    re_fid = hop_fidelity(hist, int(n_alive),
+                          bucket_k=int(cfg["bucket_k"]),
+                          alpha=int(cfg["alpha"]),
+                          quorum=int(cfg["quorum"]), band_tv=band)
+    if abs(re_fid["tv"] - fid.get("tv", -1)) > 1e-4:
+        errs.append(f"hop_fidelity tv {fid.get('tv')!r} != recomputed "
+                    f"{re_fid['tv']} (the recorded comparison must "
+                    f"match the model this checker derives)")
+    if re_fid["tv"] > band:
+        errs.append(f"measured hop histogram {re_fid['tv']:.4f} total "
+                    f"variation from the analytic model — outside the "
+                    f"stated band {band}")
+    if abs(re_fid["median_measured"] - re_fid["median_model"]) \
+            > HOP_MEDIAN_TOL:
+        errs.append(
+            f"hop median {re_fid['median_measured']} vs analytic "
+            f"{re_fid['median_model']} — beyond the ±{HOP_MEDIAN_TOL} "
+            f"round tolerance")
+
+    # Bench-row consistency: the gated coverage value must be the
+    # steady-state mean of the sweeps it claims to summarize.
+    post = sweeps[1:] or sweeps
+    want_val = sum(r["coverage"] for r in post) / len(post)
+    if _num(bench.get("value")) and abs(bench["value"] - want_val) \
+            > 1e-5:
+        errs.append(f"bench coverage {bench['value']} != mean post-"
+                    f"initial sweep coverage {want_val:.6f}")
+    lag_max_all = [r["lag_max"] for r in sweeps if r["lag_count"]]
+    row_lag = bench.get("detection_lag_max")
+    if lag_max_all and (not _num(row_lag)
+                        or row_lag != max(lag_max_all)):
+        errs.append(f"bench detection_lag_max {row_lag!r} != max over "
+                    f"sweeps {max(lag_max_all)}")
+    return errs
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) != 1:
@@ -439,6 +603,18 @@ def main(argv=None) -> int:
         print(f"check_trace: serve OK — {life['completed']} completed "
               f"({life['in_flight']} in flight), p50 "
               f"{q['p50'] * 1e3:.1f} ms, p99 {q['p99'] * 1e3:.1f} ms")
+        return 0
+    if obj.get("kind") == "swarm_monitor_trace":
+        errs = check_monitor_obj(obj)
+        if errs:
+            for e in errs:
+                print(f"check_trace: {e}")
+            return 1
+        sweeps = obj["monitor"]["sweeps"]
+        fid = obj["monitor"]["hop_fidelity"]
+        print(f"check_trace: monitor OK — {len(sweeps)} sweeps, "
+              f"final coverage {sweeps[-1]['coverage']:.4f}, "
+              f"hop tv {fid['tv']:.4f} (band {fid['band_tv']})")
         return 0
     if obj.get("kind") == "cost_ledger":
         errs = check_ledger_obj(obj)

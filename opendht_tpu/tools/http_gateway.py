@@ -31,6 +31,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..core.value import Value
 from ..obs.latency import LatencyPlane
 from ..utils.infohash import InfoHash
+from ..utils.metrics import PROMETHEUS_CONTENT_TYPE
 from ..utils.sockaddr import AF_INET, AF_INET6
 from .common import add_common_args, start_node
 
@@ -68,7 +69,7 @@ def make_handler(node, latency: LatencyPlane | None = None):
             self.wfile.write(body)
 
         def _reply_text(self, code: int, text: str,
-                        ctype: str = "text/plain; version=0.0.4") -> None:
+                        ctype: str = PROMETHEUS_CONTENT_TYPE) -> None:
             body = text.encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype)

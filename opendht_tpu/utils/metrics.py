@@ -24,6 +24,10 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
+#: Content type of the text exposition every scrape surface serves
+#: (the gateway's /metrics route and standalone ``serve_metrics``).
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
 
 def _label_key(label_names: Sequence[str], labels: Dict[str, str]
                ) -> LabelKey:
@@ -331,3 +335,47 @@ class MetricsRegistry:
 
     def to_dict(self) -> Dict[str, object]:
         return {m.name: m.to_json() for m in self.collect()}
+
+
+def serve_metrics(registry: MetricsRegistry, port: int,
+                  host: str = "127.0.0.1"):
+    """Serve ``registry`` as Prometheus text exposition 0.0.4 on
+    ``http://host:port/metrics`` from a daemon thread.
+
+    Standalone scrape surface for tools without their own HTTP server
+    (dhtscanner; anything long-running enough to scrape). The HTTP
+    gateway instead mounts /metrics as a route on its main server so
+    one port covers both the REST API and the scrape (it needs a
+    node-state refresh hook at scrape time).
+
+    Returns the server; ``shutdown()`` also closes the listening
+    socket. ``port=0`` binds an ephemeral port (read it back from
+    ``server_address[1]``).
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") not in ("/metrics", ""):
+                self.send_error(404)
+                return
+            body = registry.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet: progress lives in metrics
+            pass
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+
+        def shutdown(self):
+            super().shutdown()
+            self.server_close()
+
+    srv = Server((host, port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv

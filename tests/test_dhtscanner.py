@@ -1,0 +1,186 @@
+"""dhtscanner unit tests (ISSUE 8 satellite — previously the only
+tool with zero tests): keyspace-split termination, duplicate-node
+dedup, and the metrics surface."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from opendht_tpu.core.constants import TARGET_NODES
+from opendht_tpu.tools.dhtscanner import MAX_DEPTH, Scanner
+from opendht_tpu.utils.infohash import InfoHash
+from opendht_tpu.utils.metrics import MetricsRegistry
+
+
+def _node(i: int):
+    # 32-bit id space: the MAX_DEPTH walk returns 2*(2^13 - 1) * 8
+    # node sightings, so a narrower id space would saturate and stop
+    # the recursion before the depth cap does.
+    return SimpleNamespace(
+        id=InfoHash(i.to_bytes(4, "big") * 5),
+        addr=SimpleNamespace(host="127.0.0.1", port=4000 + (i & 0xFFF)))
+
+
+class StubNode:
+    """Synchronous stand-in for DhtRunner.get: every search returns
+    ``per_call`` nodes, fresh ones until ``fresh_budget`` runs out,
+    then repeats already-returned nodes (the dedup path)."""
+
+    def __init__(self, fresh_budget=10 ** 9, per_call=TARGET_NODES,
+                 values=()):
+        self.fresh_budget = fresh_budget
+        self.per_call = per_call
+        self.values = list(values)
+        self.counter = 0
+        self.calls = 0
+
+    def get(self, target, value_cb, done_cb):
+        self.calls += 1
+        if self.values:
+            value_cb(self.values)
+        nodes = []
+        for _ in range(self.per_call):
+            if self.counter < self.fresh_budget:
+                self.counter += 1
+                nodes.append(_node(self.counter))
+            else:
+                nodes.append(_node(1 + self.calls % max(
+                    1, self.counter)))
+        done_cb(True, nodes)
+
+
+class TestScannerTermination:
+    def test_stops_when_no_fresh_nodes(self):
+        # 2 root searches exhaust the fresh budget; nothing splits.
+        node = StubNode(fresh_budget=TARGET_NODES - 1)
+        sc = Scanner(node, MetricsRegistry())
+        seen = sc.scan()
+        assert node.calls == 2
+        assert len(seen) == TARGET_NODES - 1
+        assert sc.pending == 0 and sc.done_evt.is_set()
+
+    def test_splits_while_subtrees_stay_fresh(self):
+        # The walk is DEPTH-first (splits recurse inside on_done), so
+        # a 2*TARGET_NODES budget is spent by root 1 and its first
+        # child: root1 splits (8 fresh), child A splits' worth of
+        # fresh is exhausted... root1 -> A (split) -> A1, A2, B dry,
+        # root2 dry.
+        node = StubNode(fresh_budget=2 * TARGET_NODES)
+        sc = Scanner(node, MetricsRegistry())
+        sc.scan()
+        assert node.calls == 6
+        assert sc.registry.get(
+            "dht_scanner_buckets_split_total").get() == 2.0
+
+    def test_max_depth_caps_recursion(self):
+        # Unlimited fresh nodes: only MAX_DEPTH stops the walk.
+        node = StubNode()
+        sc = Scanner(node, MetricsRegistry())
+        sc.scan()
+        # Full binary walk: 2 roots at depth 0, doubling to depth
+        # MAX_DEPTH, no splits past it.
+        assert node.calls == 2 * (2 ** (MAX_DEPTH + 1) - 1)
+        assert sc.registry.get("dht_scanner_depth_max").get() \
+            == MAX_DEPTH
+
+
+class TestScannerAsyncCompletion:
+    def test_sync_first_root_does_not_truncate_scan(self):
+        # First root completes synchronously inside its dispatch; the
+        # second completes from another thread. Without the guard ref
+        # in scan(), the first completion drops pending to 0 and sets
+        # done_evt before the second root dispatches, so scan()
+        # returns with half the keyspace uncrawled.
+        import threading
+        import time
+
+        class MixedNode:
+            def __init__(self):
+                self.calls = 0
+
+            def get(self, target, value_cb, done_cb):
+                self.calls += 1
+                if self.calls == 1:
+                    done_cb(True, [_node(1)])
+                else:
+                    def later():
+                        time.sleep(0.05)
+                        done_cb(True, [_node(2)])
+                    threading.Thread(target=later).start()
+
+        node = MixedNode()
+        sc = Scanner(node, MetricsRegistry())
+        seen = sc.scan()
+        assert node.calls == 2
+        assert len(seen) == 2
+        assert sc.pending == 0 and sc.done_evt.is_set()
+
+
+class TestScannerDedup:
+    def test_duplicate_nodes_counted_once(self):
+        node = StubNode(fresh_budget=TARGET_NODES + 3)
+        sc = Scanner(node, MetricsRegistry())
+        seen = sc.scan()
+        assert len(seen) == TARGET_NODES + 3       # distinct only
+        reg = sc.registry
+        assert reg.get("dht_scanner_nodes_discovered_total").get() \
+            == TARGET_NODES + 3
+        dup = reg.get("dht_scanner_duplicate_nodes_total").get()
+        total_returned = node.calls * TARGET_NODES
+        assert dup == total_returned - (TARGET_NODES + 3)
+
+    def test_seen_map_keeps_first_address(self):
+        node = StubNode(fresh_budget=4)
+        sc = Scanner(node, MetricsRegistry())
+        seen = sc.scan()
+        for nid, addr in seen.items():
+            assert addr.host == "127.0.0.1"
+
+
+class TestScannerMetrics:
+    def test_lookup_and_pending_accounting(self):
+        node = StubNode(fresh_budget=TARGET_NODES - 1)
+        reg = MetricsRegistry()
+        sc = Scanner(node, reg)
+        sc.scan()
+        assert reg.get("dht_scanner_lookups_total").get(
+            status="ok") == node.calls
+        assert reg.get("dht_scanner_pending_lookups").get() == 0.0
+        assert reg.get("dht_scanner_nodes_per_second").get() >= 0.0
+
+    def test_values_counted(self):
+        node = StubNode(fresh_budget=2, values=[1, 2, 3])
+        sc = Scanner(node, MetricsRegistry())
+        sc.scan()
+        assert sc.registry.get("dht_scanner_values_seen_total").get() \
+            == 3 * node.calls
+
+    def test_prometheus_exposition_renders(self):
+        node = StubNode(fresh_budget=TARGET_NODES)
+        sc = Scanner(node, MetricsRegistry())
+        sc.scan()
+        text = sc.registry.render_prometheus()
+        assert "# TYPE dht_scanner_nodes_discovered_total counter" \
+            in text
+        assert 'dht_scanner_lookups_total{status="ok"}' in text
+
+    def test_metrics_endpoint_scrapeable(self):
+        import urllib.request
+
+        from opendht_tpu.tools.dhtscanner import serve_metrics
+        reg = MetricsRegistry()
+        sc = Scanner(StubNode(fresh_budget=3), reg)
+        srv = serve_metrics(reg, 0)
+        try:
+            port = srv.server_address[1]
+            sc.scan()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as resp:
+                body = resp.read().decode()
+            assert resp.status == 200
+            assert "dht_scanner_nodes_discovered_total 3" in body
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope")
+        finally:
+            srv.shutdown()

@@ -1,0 +1,532 @@
+"""Swarm-health monitor tests (ISSUE 8 tentpole).
+
+Three layers:
+
+* fold semantics — discovery / miss / death / resurrection / lag
+  accounting of ``models.monitor.fold_sweep`` on fabricated inputs,
+  plus the exact conservation identities the artifact gate relies on;
+* pure-observer equivalence — a monitor sweep's lookup results are
+  bit-identical with the freshness plane on or off, on the plain
+  engine AND the 8-device routed sharded engine (the monitor must
+  never perturb what it observes);
+* the analytic plane — ``obs.health.analytic_hop_pmf`` against a real
+  measured crawl, the Poisson density profile, the health gauges, and
+  the ``check_trace`` monitor artifact gate (pass + every failure
+  class).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opendht_tpu.models.monitor import (
+    MonitorConfig,
+    MonitorEngine,
+    bucket_targets,
+    empty_freshness,
+    fold_sweep,
+    kill_node_range,
+    record_kills,
+)
+from opendht_tpu.models.swarm import (
+    SwarmConfig,
+    build_swarm,
+    hop_histogram,
+    lookup,
+)
+from opendht_tpu.obs.health import (
+    SwarmHealthPlane,
+    analytic_hop_pmf,
+    hop_fidelity,
+    poisson_density_profile,
+)
+from opendht_tpu.utils.metrics import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# fold semantics on fabricated inputs
+# ---------------------------------------------------------------------------
+
+class TestFoldSweep:
+    """n=8 nodes, depth=2 (4 buckets, 2 nodes each): ids0 chosen so
+    node i sits in bucket i//2."""
+
+    MCFG = MonitorConfig(depth=2, period=4, fresh_ttl=2,
+                         stale_threshold=0.25, miss_limit=2)
+
+    def setup_method(self, _m):
+        self.n = 8
+        self.ids0 = jnp.asarray(
+            [(i // 2) << 30 | 0x1000 * i for i in range(self.n)],
+            jnp.uint32)
+        self.alive = jnp.ones((self.n,), bool)
+        self.kill = jnp.full((self.n,), -1, jnp.int32)
+
+    def fold(self, fr, found, probed, sweep, alive=None, kill=None):
+        return fold_sweep(
+            fr, jnp.asarray(found, jnp.int32),
+            jnp.asarray(probed, bool), self.ids0, jnp.int32(sweep),
+            self.alive if alive is None else jnp.asarray(alive, bool),
+            self.kill if kill is None else jnp.asarray(kill, jnp.int32),
+            self.MCFG)
+
+    def test_discovery_and_freshness(self):
+        fr, stats, age_hist, _ = self.fold(
+            empty_freshness(self.n), [[0, 3, -1]], [True, True, False,
+                                                    False], 0)
+        fr = jax.device_get(fr)
+        assert list(fr.discovered) == [0, -1, -1, 0, -1, -1, -1, -1]
+        assert list(fr.last_seen) == [0, -1, -1, 0, -1, -1, -1, -1]
+        assert int(stats["nodes_seen"]) == 2
+        assert int(stats["newly_discovered"]) == 2
+        assert int(age_hist[0]) == 2       # fresh iff seen this sweep
+        assert int(stats["tracked_alive"]) == 2
+
+    def test_miss_only_in_probed_buckets(self):
+        fr, *_ = self.fold(empty_freshness(self.n), [[0, 1, 2, 3]],
+                           [True, True, True, True], 0)
+        # Sweep 1 probes only bucket 0 and sees only node 0: node 1
+        # (bucket 0) takes a miss, nodes 2/3 (bucket 1, unprobed) age
+        # without strikes.
+        fr, stats, _, _ = self.fold(fr, [[0]],
+                                    [True, False, False, False], 1)
+        fr = jax.device_get(fr)
+        assert list(fr.missed[:4]) == [0, 1, 0, 0]
+        assert int(stats["probed_tracked"]) == 2
+        assert int(stats["probed_seen"]) == 1
+        assert int(stats["probed_missed"]) == 1
+        assert int(stats["newly_dead"]) == 0
+
+    def test_death_at_miss_limit_and_resurrection(self):
+        fr, *_ = self.fold(empty_freshness(self.n), [[0, 1]],
+                           [True, False, False, False], 0)
+        fr, s1, _, _ = self.fold(fr, [[0]], [True, False, False, False],
+                                 1)
+        assert int(s1["newly_dead"]) == 0          # miss 1 of 2
+        fr, s2, _, _ = self.fold(fr, [[0]], [True, False, False, False],
+                                 2)
+        assert int(s2["newly_dead"]) == 1          # miss 2 = limit
+        assert int(jax.device_get(fr.dead_since)[1]) == 2
+        # A later sighting resurrects and resets the strikes.
+        fr, s3, _, _ = self.fold(fr, [[0, 1]],
+                                 [True, False, False, False], 3)
+        fr = jax.device_get(fr)
+        assert int(s3["resurrected"]) == 1
+        assert fr.dead_since[1] == -1 and fr.missed[1] == 0
+
+    def test_detection_lag_against_kill_ledger(self):
+        fr, *_ = self.fold(empty_freshness(self.n), [[0, 1]],
+                           [True, False, False, False], 0)
+        kill = [-1, 1, -1, -1, -1, -1, -1, -1]     # node 1 died sweep 1
+        alive = [True, False] + [True] * 6
+        fr, s1, _, _ = self.fold(fr, [[0]], [True, False, False, False],
+                                 1, alive=alive, kill=kill)
+        assert int(s1["false_alive"]) == 1         # dead, undetected
+        fr, s2, _, _ = self.fold(fr, [[0]], [True, False, False, False],
+                                 2, alive=alive, kill=kill)
+        assert int(s2["newly_dead"]) == 1
+        assert int(s2["lag_count"]) == 1
+        assert int(s2["lag_max"]) == 1             # killed 1, marked 2
+        assert int(s2["false_alive"]) == 0
+        assert int(s2["false_detect"]) == 0
+
+    def test_false_death_is_counted(self):
+        fr, *_ = self.fold(empty_freshness(self.n), [[0, 1]],
+                           [True, False, False, False], 0)
+        # Node 1 is ALIVE but the probes keep missing it.
+        fr, _, _, _ = self.fold(fr, [[0]], [True, False, False, False],
+                                1)
+        fr, s2, _, _ = self.fold(fr, [[0]], [True, False, False, False],
+                                 2)
+        assert int(s2["newly_dead"]) == 1
+        assert int(s2["false_detect"]) == 1        # no kill on ledger
+        assert int(s2["false_dead"]) == 1          # and actually alive
+
+    def test_conservation_identities(self):
+        fr = empty_freshness(self.n)
+        prev = 0
+        found_by_sweep = [[[0, 1, 2, 3]], [[0, 2]], [[0]], [[0, 1]]]
+        probed = [True, True, False, False]
+        for s, found in enumerate(found_by_sweep):
+            fr, st, age_hist, _ = self.fold(fr, found, probed, s)
+            st = {k: int(v) for k, v in st.items()}
+            assert st["tracked_alive"] == (
+                prev + st["newly_discovered"] + st["resurrected"]
+                - st["newly_dead"])
+            assert st["probed_tracked"] == (
+                st["probed_seen"] + st["probed_missed"])
+            assert int(age_hist[0]) == st["nodes_seen"]
+            prev = st["tracked_alive"]
+
+    def test_per_bucket_counts_are_density(self):
+        fr, _, _, (tracked, stale, pending) = self.fold(
+            empty_freshness(self.n), [[0, 1, 2, 3, 4, 5, 6, 7]],
+            [True] * 4, 0)
+        assert list(jax.device_get(tracked)) == [2, 2, 2, 2]
+        assert int(jnp.sum(stale)) == 0 and int(jnp.sum(pending)) == 0
+
+    def test_record_kills_ledger(self):
+        ks = jnp.full((4,), -1, jnp.int32)
+        prev = jnp.asarray([True, True, True, False])
+        new = jnp.asarray([True, False, True, False])
+        ks = record_kills(ks, prev, new, jnp.int32(3))
+        assert list(jax.device_get(ks)) == [-1, 3, -1, -1]
+        # Already-dead nodes never restamp.
+        ks = record_kills(ks, new, jnp.asarray([True] + [False] * 3),
+                          jnp.int32(5))
+        assert list(jax.device_get(ks)) == [-1, 3, 5, -1]
+
+
+def test_bucket_targets_match_crawl_grid():
+    t = bucket_targets(np.array([0, 1, 5]), depth=3)
+    t = jax.device_get(t)
+    assert t.shape == (3, 5) and t.dtype == np.uint32
+    assert list(t[:, 0]) == [0, 1 << 29, 5 << 29]
+    assert (t[:, 1:] == 0x80000000).all()
+
+
+def test_kill_node_range():
+    cfg = SwarmConfig.for_nodes(256)
+    sw = build_swarm(jax.random.PRNGKey(0), cfg)
+    sw = kill_node_range(sw, jnp.int32(10), jnp.int32(20), cfg)
+    alive = jax.device_get(sw.alive)
+    assert not alive[10:20].any() and alive[:10].all() \
+        and alive[20:].all()
+
+
+# ---------------------------------------------------------------------------
+# pure-observer equivalence: the plane never perturbs the lookups
+# ---------------------------------------------------------------------------
+
+class TestPureObserver:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        cfg = SwarmConfig.for_nodes(4096)
+        swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+        return cfg, swarm
+
+    def _schedule(self, engine, n_sweeps=3):
+        """Drive an engine with kills, returning its bucket schedule,
+        keys and results."""
+        out = []
+        for s in range(n_sweeps):
+            if s:
+                engine.kill(0.1, jax.random.PRNGKey(50 + s))
+            rec, res = engine.sweep(jax.random.PRNGKey(90 + s))
+            out.append((engine.records[-1], res))
+        return out
+
+    def test_plain_engine_bit_identical_on_off(self, setup):
+        cfg, swarm = setup
+        eng_on = MonitorEngine(swarm, cfg)
+        eng_off = MonitorEngine(swarm, cfg, track_freshness=False)
+        for s in range(3):
+            if s:
+                k = jax.random.PRNGKey(50 + s)
+                eng_on.kill(0.1, k)
+                eng_off.kill(0.1, k)
+            buckets = eng_on.select_buckets()
+            key = jax.random.PRNGKey(90 + s)
+            _, r_on = eng_on.sweep(key, buckets=buckets)
+            _, r_off = eng_off.sweep(key, buckets=buckets)
+            for a, b in zip(r_on, r_off):
+                assert (jax.device_get(a) == jax.device_get(b)).all()
+
+    def test_tracked_sweep_equals_raw_lookup(self, setup):
+        cfg, swarm = setup
+        eng = MonitorEngine(swarm, cfg)
+        for s in range(2):
+            buckets = eng.select_buckets()
+            key = jax.random.PRNGKey(90 + s)
+            targets = bucket_targets(buckets, eng.mcfg.depth)
+            raw = lookup(swarm, cfg, targets, key)
+            _, res = eng.sweep(key, buckets=buckets)
+            for a, b in zip(res, raw):
+                assert (jax.device_get(a) == jax.device_get(b)).all()
+
+    @pytest.fixture()
+    def mesh8(self):
+        from opendht_tpu.parallel import make_mesh
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        return make_mesh(8)
+
+    def test_sharded_engine_bit_identical_on_off(self, setup, mesh8):
+        cfg, swarm = setup
+        eng_on = MonitorEngine(swarm, cfg, mesh=mesh8)
+        eng_off = MonitorEngine(swarm, cfg, mesh=mesh8,
+                                track_freshness=False)
+        for s in range(2):
+            if s:
+                k = jax.random.PRNGKey(50 + s)
+                eng_on.kill(0.1, k)
+                eng_off.kill(0.1, k)
+            buckets = eng_on.select_buckets()
+            key = jax.random.PRNGKey(90 + s)
+            _, r_on = eng_on.sweep(key, buckets=buckets)
+            _, r_off = eng_off.sweep(key, buckets=buckets)
+            for a, b in zip(r_on, r_off):
+                assert (jax.device_get(a) == jax.device_get(b)).all()
+
+    def test_sharded_sweep_equals_direct_sharded_lookup(self, setup,
+                                                        mesh8):
+        from opendht_tpu.parallel.sharded import sharded_lookup
+        cfg, swarm = setup
+        eng = MonitorEngine(swarm, cfg, mesh=mesh8)
+        buckets = eng.select_buckets()
+        key = jax.random.PRNGKey(91)
+        targets = bucket_targets(buckets, eng.mcfg.depth)
+        raw = sharded_lookup(swarm, cfg, targets, key, mesh8,
+                             capacity_factor=2.0)
+        _, res = eng.sweep(key, buckets=buckets)
+        for a, b in zip(res, raw):
+            assert (jax.device_get(a) == jax.device_get(b)).all()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end monitoring behavior
+# ---------------------------------------------------------------------------
+
+class TestMonitorEndToEnd:
+    def test_kill_detected_within_bound(self):
+        cfg = SwarmConfig.for_nodes(4096)
+        swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+        eng = MonitorEngine(swarm, cfg)
+        bound = eng.mcfg.detection_lag_bound
+        eng.sweep(jax.random.PRNGKey(300))
+        for s in range(1, 2 * eng.mcfg.period + 2):
+            eng.kill(0.05, jax.random.PRNGKey(100 + s))
+            eng.heal(jax.random.PRNGKey(200 + s))
+            rec, _ = eng.sweep(jax.random.PRNGKey(300 + s))
+            if rec["lag_count"]:
+                assert rec["lag_max"] <= bound
+        assert sum(r["lag_count"] for r in eng.records) > 0
+        assert eng.records[-1]["coverage"] > 0.97
+
+    def test_localized_outage_detected(self):
+        cfg = SwarmConfig.for_nodes(4096)
+        swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+        eng = MonitorEngine(swarm, cfg)
+        bound = eng.mcfg.detection_lag_bound
+        eng.sweep(jax.random.PRNGKey(300))
+        eng.kill_range(1024, 1536)      # 12.5% contiguous outage
+        detected = 0
+        for s in range(1, bound + 1):
+            rec, _ = eng.sweep(jax.random.PRNGKey(300 + s))
+            if rec["lag_count"]:
+                assert rec["lag_max"] <= bound
+            detected += rec["lag_count"]
+        # Essentially the whole outage range confirmed dead in-bound.
+        assert detected >= 0.95 * 512
+
+    def test_every_bucket_probed_within_period(self):
+        cfg = SwarmConfig.for_nodes(2048)
+        swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+        eng = MonitorEngine(swarm, cfg)
+        period = eng.mcfg.period
+        probed_at = {}
+        for s in range(2 * period + 1):
+            buckets = eng.select_buckets()
+            for b in buckets:
+                probed_at.setdefault(int(b), []).append(s)
+            eng.sweep(jax.random.PRNGKey(300 + s), buckets=buckets)
+        for b in range(eng.n_buckets):
+            times = probed_at.get(b, [])
+            assert times, f"bucket {b} never probed"
+            gaps = np.diff([0] + times + [2 * period])
+            assert gaps.max() <= period + 1
+
+    def test_incremental_sweeps_probe_less_than_full(self):
+        cfg = SwarmConfig.for_nodes(2048)
+        swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+        eng = MonitorEngine(swarm, cfg)
+        r0, _ = eng.sweep(jax.random.PRNGKey(0))
+        assert r0["buckets_probed"] == eng.n_buckets   # initial crawl
+        r1, _ = eng.sweep(jax.random.PRNGKey(1))
+        assert r1["buckets_probed"] <= eng.n_buckets // 2
+
+
+# ---------------------------------------------------------------------------
+# the analytic plane: hop model, density law, gauges, artifact gate
+# ---------------------------------------------------------------------------
+
+def test_analytic_hop_pmf_is_a_distribution():
+    for n in (2048, 65536, 1 << 20):
+        pmf = analytic_hop_pmf(n)
+        assert pmf.shape == (49,)
+        assert abs(pmf.sum() - 1.0) < 1e-9 and (pmf >= 0).all()
+
+
+def test_analytic_model_matches_measured_crawl():
+    """The model-based fidelity gate, held against a REAL crawl."""
+    cfg = SwarmConfig.for_nodes(4096)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    targets = jax.random.bits(jax.random.PRNGKey(1), (4096, 5),
+                              jnp.uint32)
+    res = lookup(swarm, cfg, targets, jax.random.PRNGKey(2))
+    hist = jax.device_get(hop_histogram(res.hops, cfg.max_steps))
+    fid = hop_fidelity(hist, 4096, bucket_k=cfg.bucket_k,
+                       alpha=cfg.alpha, quorum=cfg.quorum)
+    assert fid["ok"], fid
+    assert fid["tv"] <= fid["band_tv"]
+    assert abs(fid["median_measured"] - fid["median_model"]) <= 1
+
+
+def test_poisson_density_profile():
+    rng = np.random.default_rng(7)
+    prof = poisson_density_profile(rng.poisson(4.0, size=2048))
+    assert abs(sum(prof["observed_pmf"]) - 1.0) < 1e-6
+    assert prof["tv"] < 0.1
+    # A pathological density (everything in one bucket) is far from
+    # the Poisson law.
+    skew = np.zeros(2048, int)
+    skew[0] = 8192
+    assert poisson_density_profile(skew)["tv"] > 0.5
+
+
+def test_health_plane_publishes():
+    reg = MetricsRegistry()
+    plane = SwarmHealthPlane(reg)
+    rec = {"sweep": 3, "buckets_probed": 64, "lookups": 64,
+           "done_frac": 1.0, "coverage": 0.995, "tracked_alive": 1000,
+           "actual_alive": 1005, "false_alive": 5, "false_dead": 0,
+           "age_p50": 1, "age_p99": 3, "nodes_seen": 500,
+           "lag_count": 4, "lag_sum": 8, "lag_max": 3}
+    plane.publish_sweep(rec)
+    prof = plane.publish_density(np.full(64, 4))
+    text = reg.render_prometheus()
+    assert "dht_swarm_coverage_ratio 0.995" in text
+    assert 'dht_swarm_detection_lag_sweeps{stat="max"} 3' in text
+    assert 'dht_swarm_density_nodes{prefix="0"} 16' in text
+    assert prof["tracked_nodes"] == 256
+    # Plane-off records publish only geometry.
+    plane.publish_sweep({"sweep": 4, "buckets_probed": 8,
+                         "lookups": 8, "done_frac": 1.0})
+    assert reg.get("dht_swarm_sweeps_total").get() == 2.0
+
+
+# ---------------------------------------------------------------------------
+# the artifact gate (tools/check_trace.py check_monitor_obj)
+# ---------------------------------------------------------------------------
+
+def _monitor_artifact():
+    """Minimal internally consistent monitor artifact (n=2048 crawl
+    histogram measured shapes)."""
+    hist = [0] * 49
+    hist[3], hist[4], hist[5] = 900, 1000, 148
+    sweeps = [
+        {"sweep": 0, "buckets_probed": 512, "lookups": 512,
+         "nodes_seen": 2030, "newly_discovered": 2030, "resurrected": 0,
+         "newly_dead": 0, "tracked_alive": 2030, "covered": 2030,
+         "actual_alive": 2048, "false_alive": 0, "false_dead": 0,
+         "probed_tracked": 0, "probed_seen": 0, "probed_missed": 0,
+         "lag_sum": 0, "lag_count": 0, "lag_max": -1,
+         "nodes_fresh": 2030, "coverage": round(2030 / 2048, 6)},
+        {"sweep": 1, "buckets_probed": 128, "lookups": 128,
+         "nodes_seen": 500, "newly_discovered": 10, "resurrected": 0,
+         "newly_dead": 40, "tracked_alive": 2000, "covered": 1990,
+         "actual_alive": 1998, "false_alive": 10, "false_dead": 2,
+         "probed_tracked": 540, "probed_seen": 500,
+         "probed_missed": 40, "lag_sum": 40, "lag_count": 40,
+         "lag_max": 1, "nodes_fresh": 500,
+         "coverage": round(1990 / 1998, 6)},
+    ]
+    fid = hop_fidelity(hist, 2048)
+    return {
+        "kind": "swarm_monitor_trace",
+        "bench": {"metric": "swarm_monitor_coverage",
+                  "value": sweeps[1]["coverage"],
+                  "detection_lag_max": 1},
+        "monitor": {
+            "config": {"depth": 9, "period": 4, "fresh_ttl": 2,
+                       "stale_threshold": 0.25, "miss_limit": 2,
+                       "age_cap": 64, "detection_lag_bound_sweeps": 5,
+                       "bucket_k": 8, "alpha": 4, "quorum": 8,
+                       "max_steps": 48},
+            "sweeps": sweeps,
+            "hop_histogram_initial": hist,
+            "initial_alive": 2048,
+            "hop_fidelity": fid,
+        },
+    }
+
+
+class TestCheckMonitor:
+    def check(self, obj):
+        from opendht_tpu.tools.check_trace import check_monitor_obj
+        return check_monitor_obj(obj)
+
+    def test_consistent_artifact_passes(self):
+        assert self.check(_monitor_artifact()) == []
+
+    def test_broken_conservation_fails(self):
+        obj = _monitor_artifact()
+        obj["monitor"]["sweeps"][1]["tracked_alive"] += 7
+        assert any("conserve" in e for e in self.check(obj))
+
+    def test_probe_accounting_fails(self):
+        obj = _monitor_artifact()
+        obj["monitor"]["sweeps"][1]["probed_missed"] += 1
+        assert any("probed_tracked" in e for e in self.check(obj))
+
+    def test_fresh_means_seen(self):
+        obj = _monitor_artifact()
+        obj["monitor"]["sweeps"][1]["nodes_fresh"] -= 5
+        assert any("nodes_fresh" in e for e in self.check(obj))
+
+    def test_lag_beyond_bound_fails(self):
+        obj = _monitor_artifact()
+        obj["monitor"]["sweeps"][1]["lag_max"] = 6
+        assert any("lag_max" in e for e in self.check(obj))
+
+    def test_fabricated_bound_fails(self):
+        obj = _monitor_artifact()
+        obj["monitor"]["config"]["detection_lag_bound_sweeps"] = 99
+        assert any("detection_lag_bound" in e for e in self.check(obj))
+
+    def test_hop_histogram_off_model_fails(self):
+        obj = _monitor_artifact()
+        hist = [0] * 49
+        hist[12] = 2048         # convergence 3x slower than the model
+        obj["monitor"]["hop_histogram_initial"] = hist
+        errs = self.check(obj)
+        assert any("total" in e and "variation" in e or "median" in e
+                   for e in errs)
+
+    def test_fabricated_band_fails(self):
+        obj = _monitor_artifact()
+        obj["monitor"]["hop_fidelity"]["band_tv"] = 0.9
+        assert any("band_tv" in e for e in self.check(obj))
+
+    def test_fabricated_tv_fails(self):
+        obj = _monitor_artifact()
+        obj["monitor"]["hop_fidelity"]["tv"] = 0.0001
+        assert any("recomputed" in e for e in self.check(obj))
+
+    def test_bench_row_must_match_sweeps(self):
+        obj = _monitor_artifact()
+        obj["bench"]["value"] = 0.9999
+        assert any("mean post-initial" in e for e in self.check(obj))
+
+
+def test_check_bench_coverage_floor(tmp_path):
+    import json
+
+    from opendht_tpu.tools.check_bench import check_bench_rows
+    base = {"metric": "swarm_crawl_coverage", "value": 0.99,
+            "platform": "cpu"}
+    good = dict(base, value=0.985, platform="tpu")  # cross-platform OK
+    bad = dict(base, value=0.97)
+    assert check_bench_rows(good, base) == []
+    errs = check_bench_rows(bad, base)
+    assert errs and "99%" in errs[0]
+    # Monitor rows: the recorded lag bound gates the measured lag.
+    mbase = {"metric": "swarm_monitor_coverage", "value": 0.995,
+             "detection_lag_bound_sweeps": 5, "platform": "cpu"}
+    mcur = {"metric": "swarm_monitor_coverage", "value": 0.995,
+            "detection_lag_max": 7, "platform": "cpu"}
+    assert any("detection_lag_max" in e
+               for e in check_bench_rows(mcur, mbase))
+    mcur["detection_lag_max"] = 4
+    assert check_bench_rows(mcur, mbase) == []
