@@ -4,7 +4,7 @@
 
 NATIVE_SRC := opendht_tpu/native/dhtcore.cpp
 
-.PHONY: all native test bench gate profile clean
+.PHONY: all native test bench lint gate profile clean
 
 all: native
 
@@ -13,6 +13,19 @@ native:
 
 test:
 	python -m pytest tests/ -q
+
+# Static device-invariant analyzer (README "Static analysis").  Three
+# planes: the pure-AST lint (jit hygiene, donated-reuse, lock
+# discipline, ledger registry drift — no JAX import), the lowering
+# plane (every ledger ENTRY_POINTS jit is lowered from its recorded
+# abstract shapes and declared donation must materialize as REAL
+# input<->output aliasing in the compiled executable; no f64, no host
+# callbacks), and the strict-mode replay (tier-1 subset under
+# jax_transfer_guard=disallow + rank_promotion=raise + debug_nans).
+# Exit 0 = clean; any finding (unsuppressed by a justified
+# `# graftlint: disable=<rule> (<reason>)` pragma) is a failure.
+lint:
+	python -m opendht_tpu.tools.graftlint --plane all
 
 bench:
 	python bench.py
@@ -71,7 +84,10 @@ bench:
 # (coverage floor + lag bound vs the recorded MONITOR_GATE_r08.json);
 # the checked-in 1M acceptance artifact MONITOR_r08.json is
 # re-validated so the committed record can never rot.
-gate: test
+# The LINT leg runs FIRST: perf artifacts must never be recorded from
+# an unlinted tree (a dropped donation or implicit per-round transfer
+# would silently tax every number the gate then blesses).
+gate: lint test
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	python -m pytest tests/test_merge_equivalence.py -q
 	python bench.py --nodes 100000 --lookups 20000 --repeat 2 --recall-sample 256 --trace-out /tmp/trace.json --ledger-out /tmp/ledger.json

@@ -61,6 +61,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.hostdevice import dev_i32
 from .swarm import (
     LookupFaults,
     LookupResult,
@@ -421,7 +422,7 @@ class MonitorEngine:
             probed[buckets] = True
             self.fresh, stats, age_hist, bcounts = fold_sweep(
                 self.fresh, res.found, jnp.asarray(probed),
-                self.swarm.ids[:, 0], jnp.int32(s), self.swarm.alive,
+                self.swarm.ids[:, 0], dev_i32(s), self.swarm.alive,
                 self.kill_sweep, self.mcfg)
             stats, age_hist, bcounts = jax.device_get(
                 (stats, age_hist, bcounts))

@@ -51,6 +51,7 @@ from ..ops.xor_metric import (
     prefix_len32,
     rank_merge_round_d0,
 )
+from ..utils.hostdevice import dev_i32
 
 UINT32_MAX = 0xFFFFFFFF
 
@@ -322,12 +323,18 @@ def init_lifecycle(st: LookupState,
     row admitted at round ``rnd``, completion pending.  Steps must then
     receive their round index (``rnd=``) so ``_merge_round`` can stamp
     ``completed_round`` — the loops do this automatically when the
-    fields are present."""
+    fields are present.  The round scalar rides an explicit cached
+    upload and the fill runs jitted (constants fold into the program),
+    so the strict transfer-guard replay sees no implicit transfer."""
+    return _init_lifecycle_j(st, dev_i32(rnd))
+
+
+@jax.jit
+def _init_lifecycle_j(st: LookupState, rnd32: jax.Array) -> LookupState:
     l = st.done.shape[0]
     return st._replace(
-        admitted_round=jnp.full((l,), rnd, jnp.int32),
-        completed_round=jnp.where(st.done, jnp.asarray(rnd, jnp.int32),
-                                  jnp.int32(-1)))
+        admitted_round=jnp.broadcast_to(rnd32, (l,)),
+        completed_round=jnp.where(st.done, rnd32, jnp.int32(-1)))
 
 
 class LookupTrace(NamedTuple):
@@ -380,6 +387,7 @@ class LookupTrace(NamedTuple):
     rounds: jax.Array       # []  int32
 
 
+@partial(jax.jit, static_argnames=("cfg",))
 def empty_lookup_trace(cfg: SwarmConfig) -> LookupTrace:
     z = jnp.zeros((cfg.max_steps,), jnp.int32)
     return LookupTrace(requests=z, replies=z, drops=z, poison=z,
@@ -1207,8 +1215,11 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     st = lookup_init(swarm, cfg, targets, origins)
     if track_lifecycle:
         st = init_lifecycle(st)
-    rnd_of = (lambda r: jnp.int32(r)) if track_lifecycle \
-        else (lambda r: None)
+    # EXPLICIT cached upload (utils/hostdevice) for the per-round
+    # coordinate: spelled jnp.int32(r) it is an implicit host→device
+    # transfer every round, which graftlint's strict transfer-guard
+    # replay forbids on steady-state loops.
+    rnd_of = dev_i32 if track_lifecycle else (lambda r: None)
     if timing:
         jax.block_until_ready(st)
         t1 = time.perf_counter()
@@ -1281,7 +1292,11 @@ def run_burst_loop(step_fn, state, cfg: SwarmConfig,
         for _ in range(n):
             state = step_fn(state, rounds)
             rounds += 1
-        if bool(jnp.all(done_of(state))):
+        # Per-BURST done poll (explicit device_get: bool() on a device
+        # array is an implicit D2H transfer, forbidden under the
+        # strict transfer-guard replay).
+        # graftlint: disable=sync-in-loop (per-burst done-check readback, amortized over >=2 device rounds — the burst loop exists to pay this once per burst, not per round)
+        if bool(jax.device_get(jnp.all(done_of(state)))):
             break
         burst = 2
     return state
@@ -1373,9 +1388,27 @@ def _writeback_prefix(full: LookupState, sub: LookupState) -> LookupState:
                          for f, s in zip(full, sub)])
 
 
+@partial(jax.jit, static_argnames=("lim",))
+def _ge_limit(x: jax.Array, lim: int) -> jax.Array:
+    """``x >= lim`` with the Python-int limit folded as a program
+    constant instead of an eager per-call scalar upload."""
+    return x >= lim
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _zeros_i32(n: int) -> jax.Array:
+    """``[n]`` int32 zeros as a compiled program constant — eager
+    ``jnp.zeros`` is a fresh host→device upload per call, which the
+    strict transfer-guard replay forbids on engine paths."""
+    return jnp.zeros((n,), jnp.int32)
+
+
+@jax.jit
 def _scatter_rows(x: jax.Array, order: jax.Array) -> jax.Array:
     """Return rows to their pre-compaction batch positions (``order[i]``
-    is row ``i``'s original index)."""
+    is row ``i``'s original index).  Jitted so the zero template is a
+    program constant, not a fresh host upload per call (strict
+    transfer-guard hygiene)."""
     return jnp.zeros_like(x).at[order].set(x)
 
 
@@ -1435,7 +1468,8 @@ def run_compacted_burst_loop(step_fn, st: LookupState, cfg: SwarmConfig,
             row_rounds += w
         if w not in widths:
             widths.append(w)
-        pending = int(jnp.sum(~sub.done))
+        # graftlint: disable=sync-in-loop (per-burst pending readback steers the ladder width — amortized over >=2 device rounds)
+        pending = int(jax.device_get(jnp.sum(~sub.done)))
         if timing:
             stats.setdefault("burst_walls", []).append(
                 (time.perf_counter() - tb, n))
@@ -1514,7 +1548,7 @@ def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     if not compact:
         st, trace = run_burst_loop(
             lambda c, r: traced_lookup_step(swarm, cfg, c[0], c[1],
-                                            jnp.int32(r)),
+                                            dev_i32(r)),
             (st, trace), cfg, done_of=lambda c: c[0].done)
         if track_lifecycle and stats is not None:
             stats["admitted_round"] = st.admitted_round
@@ -1524,7 +1558,7 @@ def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
 
     def step(s, ex, r, hidden):
         s, tr = _traced_lookup_step_d(swarm, cfg, s, ex[0],
-                                      jnp.int32(r), hidden)
+                                      dev_i32(r), hidden)
         return s, (tr,)
 
     st, (trace,), order = run_compacted_burst_loop(
@@ -1983,7 +2017,7 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         # The chaos steps always carry their round index (the fault
         # stream's coordinate), so lifecycle needs no extra plumbing.
         st = init_lifecycle(st)
-    strikes = jnp.zeros((cfg.n_nodes,), jnp.int32)
+    strikes = _zeros_i32(cfg.n_nodes)
     byz_aux = (byz_colluder_pool(swarm.byzantine)
                if faults.eclipse and swarm.byzantine is not None
                else None)
@@ -1998,7 +2032,7 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         def step(s, ex, r, hidden):
             prev["strikes"] = ex[0]
             out = _chaos_step_d(swarm, cfg, faults, s, ex[0],
-                                jnp.int32(r), byz_aux,
+                                dev_i32(r), byz_aux,
                                 trace=(ex[1] if collect_trace else None),
                                 done_base=hidden)
             return out[0], tuple(out[1:])
@@ -2017,8 +2051,11 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         if faults.defend:
             # Frozen done rows missed the per-round blacklist scrubs —
             # apply them in one deferred pass (see _evict_blacklisted).
+            # The limit compare runs jitted: an eager `>= python-int`
+            # uploads the scalar every call (strict-transfer hygiene).
             st = _evict_blacklisted(
-                st, prev["strikes"] >= faults.strike_limit, cfg)
+                st, _ge_limit(prev["strikes"], faults.strike_limit),
+                cfg)
         found, hops, done = _finalize_scattered(swarm.ids, st, order,
                                                 cfg)
         found = _censor_convicted(found, strikes, cfg, faults)
@@ -2027,14 +2064,14 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     if collect_trace:
         st, strikes, trace = run_burst_loop(
             lambda c, r: chaos_lookup_step(swarm, cfg, faults, c[0],
-                                           c[1], jnp.int32(r), byz_aux,
+                                           c[1], dev_i32(r), byz_aux,
                                            trace=c[2]),
             (st, strikes, trace0), cfg,
             done_of=lambda c: c[0].done)
     else:
         st, strikes = run_burst_loop(
             lambda c, r: chaos_lookup_step(swarm, cfg, faults, c[0],
-                                           c[1], jnp.int32(r), byz_aux),
+                                           c[1], dev_i32(r), byz_aux),
             (st, strikes), cfg, done_of=lambda c: c[0].done)
     if track_lifecycle and stats is not None:
         stats["admitted_round"] = st.admitted_round
@@ -2073,13 +2110,16 @@ def _evict_blacklisted(st: LookupState, blk: jax.Array,
     return st._replace(idx=f_idx, dist=f_dist, queried=f_q)
 
 
+@partial(jax.jit, static_argnames=("cfg", "faults"))
 def _censor_convicted(found: jax.Array, strikes: jax.Array,
                       cfg: SwarmConfig,
                       faults: LookupFaults) -> jax.Array:
     """Drop convicted nodes from reported results.  Blacklist eviction
     runs at the START of each round, so a conviction landing in the
     LAST executed round would otherwise survive in a done lookup's
-    head — the one gap in mesh-wide eviction."""
+    head — the one gap in mesh-wide eviction.  Jitted so the limit /
+    sentinel scalars fold as program constants (strict-transfer
+    hygiene)."""
     if not faults.defend:
         return found
     blk = strikes >= faults.strike_limit

@@ -64,6 +64,7 @@ from ..models.swarm import (
     table_bytes,
 )
 from ..ops.xor_metric import prefix_len32
+from ..utils.hostdevice import dev_i32
 from .mesh import AXIS, shard_map
 
 
@@ -686,8 +687,11 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         # driven carry (the while formulation's on-device loop has no
         # host round counter to stamp from).
         st = init_lifecycle(st)
-    rnd_of = (lambda r: jnp.int32(r)) if track_lifecycle \
-        else (lambda r: None)
+    # Explicit cached upload for the per-round coordinate (see
+    # utils/hostdevice; deliberately uncommitted so the scalar follows
+    # the mesh placement) — same strict-transfer-guard hygiene as the
+    # local burst loops.
+    rnd_of = dev_i32 if track_lifecycle else (lambda r: None)
     if compact is False:
         if stats is not None:
             stats["formulation"] = "burst"
@@ -724,6 +728,7 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
             row_rounds += w * n_shards
         if w not in widths:
             widths.append(w)
+        # graftlint: disable=sync-in-loop (per-BURST done-check readback, amortized over >=2 device rounds — the ladder exists to pay this once per burst, not per round)
         pend = jax.device_get(
             jnp.sum(~sub.done.reshape(n_shards, w), axis=1))
         total = int(pend.sum())

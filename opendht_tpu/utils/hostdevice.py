@@ -1,0 +1,75 @@
+"""Explicit host→device scalar uploads for the host-driven loops.
+
+The burst/serve loops hand their jits a fresh round coordinate every
+iteration.  Spelled ``jnp.int32(r)`` that is an IMPLICIT host-to-device
+transfer per round — invisible in review, invisible in the profile
+(it hides inside dispatch), and exactly the class of hot-path leak
+PR 7 caught only because it cost 4.4× on p50.  ``graftlint``'s
+strict-mode leg replays the engines under
+``jax_transfer_guard=disallow``, which forbids every implicit
+transfer; these helpers are the sanctioned spelling — an EXPLICIT
+``jax.device_put`` with a small LRU so steady-state loops reuse the
+uploaded scalar instead of re-transferring it.  The result is left
+UNCOMMITTED (no device argument) on purpose: the round coordinate
+must be free to follow the consuming computation's placement — a
+scalar pinned to one chip would force a cross-device copy per round
+on the sharded mesh.  What the guard checks is that the transfer is
+explicit, and after the first call per value there is no transfer at
+all.
+
+The cache is bounded (serve round counters grow without bound on a
+long-running service) and keyed by value; a miss is just one explicit
+upload.  Dtypes match the ``jnp.int32``/``jnp.uint32`` spellings they
+replace (strong-typed scalars), so every jit cache key — and therefore
+every compiled program — is unchanged.
+
+NEVER pass these at a DONATED jit position: the buffer is shared by
+every later cache hit for the same value, and donating it leaves a
+dead array in the LRU — the next ``dev_i32(r)`` for that value
+returns a deleted buffer and the engine crashes far from the
+offending call.  graftlint's ``donated-reuse`` rule flags a
+``dev_i32``/``dev_u32`` call placed at a donated argnum.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["dev_i32", "dev_u32"]
+
+
+@functools.lru_cache(maxsize=4096)
+def _dev_i32_cached(v: int):
+    import jax
+    return jax.device_put(np.int32(v))
+
+
+@functools.lru_cache(maxsize=4096)
+def _dev_u32_cached(v: int):
+    import jax
+    return jax.device_put(np.uint32(v))
+
+
+def dev_i32(v):
+    """int32 device scalar for ``v`` — explicit (uncommitted) upload,
+    cached so steady-state loops re-use it.  A value already on device
+    (``jax.Array``, including tracers) passes through with only a
+    dtype cast, preserving the input domain of the ``jnp.int32(v)``
+    spelling this replaces — and keeping unhashable device arrays out
+    of the LRU key."""
+    import jax
+    if isinstance(v, jax.Array):
+        return v.astype(np.int32)
+    return _dev_i32_cached(int(v))
+
+
+def dev_u32(v):
+    """uint32 device scalar for ``v`` — explicit (uncommitted) upload,
+    cached so steady-state loops re-use it.  Device values pass
+    through with only a dtype cast (see ``dev_i32``)."""
+    import jax
+    if isinstance(v, jax.Array):
+        return v.astype(np.uint32)
+    return _dev_u32_cached(int(v))

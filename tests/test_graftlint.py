@@ -1,0 +1,891 @@
+"""graftlint: every rule class must fire on a known violation, stay
+silent on clean code, and be suppressible ONLY via a justified pragma.
+
+Plane 1 fixtures are fabricated source snippets run through
+``lint_source``/``check_registry`` (no JAX import needed — the lint
+itself must work that way); the lowering-plane tests build a real tiny
+swarm and assert that a deliberately UN-donated twin of
+``_lookup_step_d`` is flagged while the real donated jit verifies
+clean — the 2x store-HBM failure mode the analyzer exists to catch.
+"""
+
+import textwrap
+
+import pytest
+
+from opendht_tpu.tools.graftlint import (
+    RULES,
+    Finding,
+    check_entry_aliasing,
+    check_registry,
+    count_aliased_params,
+    lint_source,
+    main,
+    parse_entry_points,
+    parse_pragmas,
+)
+
+
+def _lint(src, path="fixture.py", **kw):
+    return lint_source(textwrap.dedent(src), path, **kw)
+
+
+def _rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# plane 1: jit-body taint rules
+# ---------------------------------------------------------------------------
+
+class TestHostCallInJit:
+    def test_np_on_traced_value_flagged(self):
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.sum(x)
+        """)
+        assert _rules_of(fs) == ["host-call-in-jit"]
+        assert "np.sum" in fs[0].msg
+
+    def test_host_counter_augassign_clean(self):
+        # Regression: `i += 1` on a plain host counter must NOT taint
+        # it — an AugAssign target is traced iff the target or the
+        # RHS already was.
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                i = 0
+                i += 1
+                return x + np.arange(i)
+        """)
+        assert fs == []
+
+    def test_augassign_from_traced_value_tainted(self):
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                acc = 0
+                acc += x
+                return np.sum(acc)
+        """)
+        assert _rules_of(fs) == ["host-call-in-jit"]
+
+    def test_np_on_shape_metadata_clean(self):
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                n = np.log2(x.shape[0])
+                return x * n
+        """)
+        assert fs == []
+
+    def test_stdlib_random_time_flagged(self):
+        fs = _lint("""
+            import random
+            import time
+            import jax
+
+            @jax.jit
+            def f(x):
+                r = random.random()
+                t = time.time()
+                return x + r + t
+        """)
+        assert _rules_of(fs) == ["host-call-in-jit"] * 2
+
+    def test_lax_loop_body_flagged(self):
+        fs = _lint("""
+            import jax
+            from jax import lax
+            import numpy as np
+
+            def outer(x):
+                def body(c):
+                    return np.abs(c) - 1
+                return lax.while_loop(lambda c: c.any(), body, x)
+        """)
+        assert _rules_of(fs) == ["host-call-in-jit"]
+
+    def test_plain_function_not_flagged(self):
+        fs = _lint("""
+            import numpy as np
+
+            def host_helper(x):
+                return np.sum(x)
+        """)
+        assert fs == []
+
+    def test_pragma_suppresses_with_reason(self):
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                # graftlint: disable=host-call-in-jit (trace-time constant by design)
+                return x * np.float32(2.0)
+        """)
+        assert fs == []
+
+
+class TestTracerCoercion:
+    def test_float_int_bool_flagged(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                a = float(x)
+                b = int(x)
+                return a + b
+        """)
+        assert _rules_of(fs) == ["tracer-coercion"] * 2
+
+    def test_item_flagged(self):
+        fs = _lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+        """)
+        assert _rules_of(fs) == ["tracer-coercion"]
+
+    def test_float_of_static_clean(self):
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, scale):
+                return x * float(scale)
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# plane 1: host rules
+# ---------------------------------------------------------------------------
+
+class TestSyncInLoop:
+    SRC = """
+        import jax
+
+        def engine_loop(step, st):
+            for r in range(10):
+                st = step(st)
+                pend = jax.device_get(st.done)
+            return st
+    """
+
+    def test_flagged_in_engine_module(self):
+        fs = _lint(self.SRC, sync_loops=True)
+        assert _rules_of(fs) == ["sync-in-loop"]
+
+    def test_not_flagged_outside_engine_modules(self):
+        fs = _lint(self.SRC, sync_loops=False)
+        assert fs == []
+
+    def test_loop_header_flagged(self):
+        # Regression: a while TEST runs per iteration — a done-poll
+        # `while device_get(...):` used to pass silently (only the
+        # body was scanned), the same blind spot donated-reuse had
+        # for control-statement headers.  A for ITERABLE however is
+        # evaluated ONCE at loop entry: a single readback there is
+        # legitimate and must stay clean.
+        fs = _lint("""
+            import jax
+
+            def poll_loop(step, st):
+                while jax.device_get(st.done).all():
+                    st = step(st)
+                return st
+        """, sync_loops=True)
+        assert _rules_of(fs) == ["sync-in-loop"]
+        fs = _lint("""
+            import jax
+
+            def width_loop(step, st, ws):
+                for w in jax.device_get(ws):
+                    st = step(st, w)
+                return st
+        """, sync_loops=True)
+        assert fs == []
+
+    def test_implicit_coercion_flagged(self):
+        # Regression: bool(jnp.all(x)) / int(jnp.sum(x)) / .item()
+        # hide the per-iteration D2H transfer inside a builtin — the
+        # exact spelling the burst loops used to ship.  The explicit
+        # bool(jax.device_get(...)) form must flag ONCE (the
+        # device_get), not twice.
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def engine_loop(step, st):
+                while True:
+                    st = step(st)
+                    if bool(jnp.all(st.done)):
+                        break
+                    pend = int(jnp.sum(~st.done))
+                    tot = jnp.max(st.hops).item()
+                return st
+        """, sync_loops=True)
+        assert _rules_of(fs) == ["sync-in-loop"] * 3
+        assert "IMPLICIT" in fs[0].msg
+        fs = _lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def engine_loop(step, st):
+                for r in range(10):
+                    st = step(st)
+                    if bool(jax.device_get(jnp.all(st.done))):
+                        break
+                return st
+        """, sync_loops=True)
+        assert _rules_of(fs) == ["sync-in-loop"]
+        assert "device_get" in fs[0].msg
+
+    def test_module_level_loop_flagged(self):
+        # Regression: a module-level driver loop (e.g. under
+        # `if __name__ == "__main__":`) is a host loop too — only
+        # function bodies used to be scanned.
+        fs = _lint("""
+            import jax
+
+            if __name__ == "__main__":
+                while True:
+                    pend = jax.device_get(st.done)
+        """, sync_loops=True)
+        assert _rules_of(fs) == ["sync-in-loop"]
+
+    def test_outside_loop_clean(self):
+        fs = _lint("""
+            import jax
+
+            def harvest(st):
+                return jax.device_get(st.done)
+        """, sync_loops=True)
+        assert fs == []
+
+    def test_nested_def_in_loop_clean(self):
+        # Regression: DEFINING a closure inside a host loop performs
+        # no per-iteration sync — only a call would.  The flattened
+        # ast.walk used to reach into the nested body and flag it.
+        fs = _lint("""
+            import jax
+
+            def engine_loop(step, st):
+                for r in range(10):
+                    st = step(st)
+                    def harvest():
+                        return jax.device_get(st.done)
+                    h = lambda: jax.block_until_ready(st)
+                return st
+        """, sync_loops=True)
+        assert fs == []
+
+    def test_loop_inside_nested_def_flagged_once(self):
+        # A loop INSIDE a nested def is that function's own loop: it
+        # must be flagged exactly once (not re-flagged through the
+        # enclosing function's walk).
+        fs = _lint("""
+            import jax
+
+            def build(step):
+                def run(st):
+                    for r in range(10):
+                        st = step(st)
+                        jax.block_until_ready(st)
+                    return st
+                return run
+        """, sync_loops=True)
+        assert _rules_of(fs) == ["sync-in-loop"]
+
+
+class TestUnhashableStatic:
+    def test_list_literal_for_static_arg_flagged(self):
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, widths):
+                return x
+
+            def caller(x):
+                return f(x, [128, 256])
+        """)
+        assert "unhashable-static" in _rules_of(fs)
+
+    def test_tuple_literal_clean(self):
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, widths):
+                return x
+
+            def caller(x):
+                return f(x, (128, 256))
+        """)
+        assert fs == []
+
+
+class TestDonatedReuse:
+    def test_use_after_donation_flagged(self):
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(st, x):
+                return st + x
+
+            def loop(st, x):
+                out = step(st, x)
+                return st.sum() + out
+        """)
+        assert _rules_of(fs) == ["donated-reuse"]
+        assert "'st'" in fs[0].msg
+
+    def test_reassignment_clears_donation(self):
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(st, x):
+                return st + x
+
+            def loop(st, x):
+                st = step(st, x)
+                return st.sum()
+        """)
+        assert fs == []
+
+    def test_loop_backedge_flagged(self):
+        # A donation at the bottom of a loop body kills a use at the
+        # top of the next iteration.
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(st, x):
+                return st + x
+
+            def loop(st, x):
+                for _ in range(4):
+                    y = st.sum()
+                    out = step(st, x)
+                return out
+        """)
+        assert "donated-reuse" in _rules_of(fs)
+
+    def test_if_test_use_flagged(self):
+        # Regression: a done-poll on a donated carry in an ``if``
+        # HEADER is a use like any other (the branch dispatch used to
+        # recurse into bodies only and skip the test expression).
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(st, x):
+                return st + x
+
+            def loop(st, x):
+                out = step(st, x)
+                if st.done:
+                    return out
+                return out * 2
+        """)
+        assert _rules_of(fs) == ["donated-reuse"]
+        assert "'st'" in fs[0].msg
+
+    def test_while_test_use_flagged(self):
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(st, x):
+                return st + x
+
+            def loop(st, x):
+                out = step(st, x)
+                while st.done:
+                    out = out * 2
+                return out
+        """)
+        assert "donated-reuse" in _rules_of(fs)
+
+    def test_for_iter_use_flagged(self):
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(st, x):
+                return st + x
+
+            def loop(st, x):
+                out = step(st, x)
+                for row in st.rows:
+                    out = out + row
+                return out
+        """)
+        assert "donated-reuse" in _rules_of(fs)
+
+    def test_cached_scalar_at_donated_position_flagged(self):
+        # dev_i32/dev_u32 return LRU-SHARED buffers: donating one
+        # leaves a dead array in the cache and a later cache hit
+        # returns a deleted buffer (crash far from the cause).
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            from opendht_tpu.utils.hostdevice import dev_i32
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(rnd, x):
+                return x + rnd
+
+            def loop(x):
+                return step(dev_i32(3), x)
+        """)
+        assert _rules_of(fs) == ["donated-reuse"]
+        assert "dev_i32" in fs[0].msg
+
+    def test_keyword_passed_donated_arg_is_drop_not_reuse(self):
+        # jit IGNORES donation for keyword-passed args: the buffer
+        # stays live, so reading it afterwards is SAFE (no
+        # donated-reuse) — but the declared donation statically
+        # dropped, which is its own finding.
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(st, x):
+                return st + x
+
+            def loop(st, x):
+                out = step(st=st, x=x)
+                return out + st
+        """)
+        assert _rules_of(fs) == ["donation-drop"]
+        assert "KEYWORD" in fs[0].msg
+
+    def test_cached_scalar_at_undonated_position_clean(self):
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            from opendht_tpu.utils.hostdevice import dev_i32
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(st, rnd):
+                return st + rnd
+
+            def loop(st, x):
+                st = step(st, dev_i32(3))
+                return st
+        """)
+        assert fs == []
+
+    def test_sibling_function_scopes_isolated(self):
+        # Regression: a donation inside one nested function must not
+        # flag a same-named variable in a SIBLING function.
+        fs = _lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(st, x):
+                return st + x
+
+            def build():
+                def a(st, x):
+                    step(st, x)
+                def b(st, x):
+                    return st.sum()
+                return a, b
+        """)
+        assert fs == []
+
+
+class TestLockDiscipline:
+    def test_mutation_outside_lock_flagged(self):
+        fs = _lint("""
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._data = {}
+
+                def put(self, k, v):
+                    self._data[k] = v
+        """, lock_rules=True)
+        assert _rules_of(fs) == ["lock-discipline"]
+        assert "_data" in fs[0].msg
+
+    def test_mutation_inside_lock_clean(self):
+        fs = _lint("""
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._data = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._data[k] = v
+        """, lock_rules=True)
+        assert fs == []
+
+    def test_lockless_class_ignored(self):
+        fs = _lint("""
+            class Plain:
+                def put(self, k, v):
+                    self.data = v
+        """, lock_rules=True)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+class TestPragmas:
+    def test_missing_reason_is_bad_pragma(self):
+        fs = _lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                # graftlint: disable=host-call-in-jit
+                return np.sum(x)
+        """)
+        assert sorted(_rules_of(fs)) == ["bad-pragma",
+                                         "host-call-in-jit"]
+
+    def test_unknown_rule_is_bad_pragma(self):
+        _, bad = parse_pragmas(
+            "# graftlint: disable=no-such-rule (because)\n", "p.py")
+        assert [f.rule for f in bad] == ["bad-pragma"]
+        assert "no-such-rule" in bad[0].msg
+
+    def test_bad_pragma_not_suppressible(self):
+        fs = _lint("""
+            # graftlint: disable=bad-pragma (nice try)
+            # graftlint: disable=not-a-rule (x)
+        """)
+        assert "bad-pragma" in _rules_of(fs)
+
+    def test_pragma_in_docstring_ignored(self):
+        fs = _lint('''
+            DOC = """use # graftlint: disable=bogus to suppress"""
+        ''')
+        assert fs == []
+
+
+class TestGoldenFormat:
+    def test_rendered_findings_format(self):
+        src = textwrap.dedent("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.sum(x)
+        """)
+        fs = lint_source(src, "opendht_tpu/models/fix.py")
+        assert [f.render() for f in fs] == [
+            "opendht_tpu/models/fix.py:7:11: host-call-in-jit: "
+            "numpy call 'np.sum' on a traced value inside a jit "
+            "context"]
+
+    def test_finding_fields(self):
+        f = Finding("a.py", 3, 7, "f64-leak", "boom")
+        assert f.render() == "a.py:3:7: f64-leak: boom"
+
+    def test_rule_catalogue_closed(self):
+        # Every finding a fixture can produce must be documented.
+        for rule in ("host-call-in-jit", "tracer-coercion",
+                     "sync-in-loop", "unhashable-static",
+                     "donated-reuse", "lock-discipline",
+                     "registry-drift", "donation-drop", "f64-leak",
+                     "host-callback", "unexercised-entry",
+                     "strict-replay", "bad-pragma"):
+            assert rule in RULES
+
+    def test_list_rules_cli(self, capsys):
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in RULES:
+            assert rule in out
+
+
+# ---------------------------------------------------------------------------
+# registry drift (fabricated sources)
+# ---------------------------------------------------------------------------
+
+LEDGER_TMPL = """
+ENTRY_POINTS: tuple = (
+    ("pkg.mod", "step", {donate}),
+)
+"""
+
+MOD_SRC = """
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(2,))
+def step(a, b, st):
+    return st
+
+@partial(jax.jit, donate_argnums=(0,))
+def unregistered_d(st):
+    return st
+"""
+
+
+class TestRegistryDrift:
+    PATHS = {"pkg.mod": "pkg/mod.py"}
+
+    def test_wrong_argnums_flagged(self):
+        fs = check_registry(LEDGER_TMPL.format(donate="(1,)"),
+                            {"pkg.mod": MOD_SRC},
+                            module_paths=self.PATHS)
+        msgs = [f.msg for f in fs if f.rule == "registry-drift"]
+        assert any("registry says donate_argnums=(1,)" in m
+                   for m in msgs)
+
+    def test_unregistered_donating_jit_flagged(self):
+        fs = check_registry(LEDGER_TMPL.format(donate="(2,)"),
+                            {"pkg.mod": MOD_SRC},
+                            module_paths=self.PATHS)
+        assert ["registry-drift"] == _rules_of(fs)
+        assert "unregistered_d" in fs[0].msg
+
+    def test_vanished_entry_flagged(self):
+        src = "import jax\n"
+        fs = check_registry(LEDGER_TMPL.format(donate="(2,)"),
+                            {"pkg.mod": src},
+                            module_paths=self.PATHS)
+        assert any("no jit decorator" in f.msg for f in fs)
+
+    def test_ghost_module_row_flagged(self):
+        # Regression: a registered row whose MODULE name is typo'd or
+        # vanished used to be skipped silently ("outside the checked
+        # set") — with the package-wide scan it is a ghost and must
+        # fail the fast AST plane.
+        fs = check_registry(LEDGER_TMPL.format(donate="(2,)"),
+                            {"pkg.other": "import jax\n"},
+                            module_paths=self.PATHS)
+        assert any("not in the scanned set" in f.msg for f in fs)
+
+    def test_matching_registry_clean(self):
+        mod = MOD_SRC.replace(
+            "def unregistered_d", "def _helper_not_donating")
+        mod = mod.replace("@partial(jax.jit, donate_argnums=(0,))\n"
+                          "def _helper_not_donating",
+                          "@jax.jit\ndef _helper_not_donating")
+        fs = check_registry(LEDGER_TMPL.format(donate="(2,)"),
+                            {"pkg.mod": mod},
+                            module_paths=self.PATHS)
+        assert fs == []
+
+    def test_parse_entry_points(self):
+        entries = parse_entry_points(LEDGER_TMPL.format(donate="(2,)"))
+        assert entries == [("pkg.mod", "step", (2,))]
+
+    def test_real_tree_registry_clean(self):
+        # The shipped ledger registry must agree with the shipped
+        # decorators — the hand-maintained-table caveat is retired.
+        import os
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        from opendht_tpu.tools.graftlint import (
+            LEDGER_PATH,
+            REGISTRY_MODULES,
+        )
+        with open(os.path.join(root, LEDGER_PATH)) as f:
+            ledger_src = f.read()
+        srcs = {}
+        for mod, rel in REGISTRY_MODULES.items():
+            with open(os.path.join(root, rel)) as f:
+                srcs[mod] = f.read()
+        assert check_registry(ledger_src, srcs) == []
+
+
+# ---------------------------------------------------------------------------
+# alias-table parsing
+# ---------------------------------------------------------------------------
+
+class TestAliasParsing:
+    def test_nested_brace_table(self):
+        hlo = ("HloModule jit_f, is_scheduled=true, "
+               "input_output_alias={ {0}: (0, {}, may-alias), "
+               "{1}: (2, {}, must-alias) }, "
+               "entry_computation_layout={(f32[8]{0})->f32[8]{0}}")
+        assert count_aliased_params(hlo) == {0, 2}
+
+    def test_no_table(self):
+        assert count_aliased_params("HloModule jit_f") == set()
+
+
+# ---------------------------------------------------------------------------
+# plane 2: the lowering-level donation check on the REAL round step
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_round_avals():
+    import jax
+
+    from opendht_tpu.models import swarm as sw
+    from opendht_tpu.obs.ledger import _abstractify
+
+    cfg = sw.SwarmConfig.for_nodes(2048)
+    swarm = sw.build_swarm(jax.random.PRNGKey(7), cfg)
+    targets = jax.random.bits(jax.random.PRNGKey(1), (64, 5),
+                              "uint32")
+    origins = sw._sample_origins(jax.random.PRNGKey(2), swarm.alive,
+                                 64)
+    st = sw.lookup_init(swarm, cfg, targets, origins)
+    return sw, _abstractify(((swarm, cfg, st), {}))
+
+
+class TestLoweringPlane:
+    def test_undonated_twin_flagged(self, tiny_round_avals):
+        # lookup_step IS the un-donated twin of _lookup_step_d (same
+        # signature, no donate_argnums).  Claiming donation for it must
+        # produce a donation-drop finding — this is how a silently
+        # dropped donation (the 2x store-HBM failure mode) surfaces.
+        sw, avals = tiny_round_avals
+        fs = check_entry_aliasing(sw.lookup_step, "twin", (2,), avals)
+        assert "donation-drop" in _rules_of(fs)
+        assert "donate_argnums=(2,)" in fs[0].msg
+
+    def test_real_donated_step_verifies(self, tiny_round_avals):
+        sw, avals = tiny_round_avals
+        fs = check_entry_aliasing(sw._lookup_step_d, "real", (2,),
+                                  avals)
+        assert fs == []
+
+    def test_f64_leak_flagged(self):
+        import jax
+        import jax.numpy as jnp
+
+        from opendht_tpu.obs.ledger import _abstractify
+
+        with jax.experimental.enable_x64():
+            @jax.jit
+            def leaky(x):
+                return x.astype(jnp.float64) * 2.0
+
+            avals = _abstractify(
+                ((jnp.zeros((8,), jnp.float32),), {}))
+            fs = check_entry_aliasing(leaky, "leaky", (), avals)
+        assert _rules_of(fs) == ["f64-leak"]
+
+    def test_host_callback_flagged(self):
+        import jax
+        import jax.numpy as jnp
+
+        from opendht_tpu.obs.ledger import _abstractify
+
+        @jax.jit
+        def chatty(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x * 2
+
+        avals = _abstractify(((jnp.zeros((8,), jnp.float32),), {}))
+        fs = check_entry_aliasing(chatty, "chatty", (), avals)
+        assert "host-callback" in _rules_of(fs)
+
+    def test_broken_workload_is_finding_not_crash(self, monkeypatch):
+        # Regression: one raising canonical workload used to abort
+        # the whole plane as an exit-2 internal error; it must
+        # degrade to findings naming the root cause (plus per-entry
+        # unexercised-entry rows), like the strict plane does.
+        import opendht_tpu.tools.graftlint as gl
+
+        def boom():
+            raise RuntimeError("backend already initialized")
+
+        monkeypatch.setattr(gl, "_build_workloads",
+                            lambda: {"boom": boom})
+        fs = gl.run_plane_lower("opendht_tpu")
+        assert fs and all(f.rule == "unexercised-entry" for f in fs)
+        assert any("boom" in f.msg and "RuntimeError" in f.msg
+                   for f in fs)
+
+    def test_keyword_passed_donation_flagged(self):
+        # Regression: jit silently ignores donate_argnums for
+        # keyword-passed arguments.  A workload that recorded the
+        # donated arg in kwargs used to shrink `expected` to 0 and
+        # report the entry CLEAN — the exact silent-drop class the
+        # plane exists to catch.
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        from opendht_tpu.obs.ledger import _abstractify
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def step(x, carry):
+            return x, carry + x
+
+        z = jnp.zeros((8,), jnp.float32)
+        avals = _abstractify(((z,), {"carry": z}))
+        fs = check_entry_aliasing(step, "step", (1,), avals)
+        assert "donation-drop" in _rules_of(fs)
+        assert "KEYWORD" in fs[0].msg
+
+
+# ---------------------------------------------------------------------------
+# utils.hostdevice: the sanctioned explicit-upload spelling
+# ---------------------------------------------------------------------------
+
+class TestHostDevice:
+    def test_cached_upload_identity(self):
+        from opendht_tpu.utils.hostdevice import dev_i32, dev_u32
+        a = dev_i32(7)
+        assert a.dtype == "int32" and int(a) == 7
+        assert dev_i32(7) is a          # steady-state: no re-upload
+        assert dev_u32(7).dtype == "uint32"
+
+    def test_device_array_passes_through(self):
+        # Regression: the jnp.int32(rnd) spellings these replace
+        # accepted a device scalar (engine callers pass one, e.g.
+        # ServeEngine.step(st, jnp.int32(5))); an unhashable
+        # jax.Array must bypass the LRU, not crash its key.
+        import jax.numpy as jnp
+
+        from opendht_tpu.utils.hostdevice import dev_i32, dev_u32
+        r = jnp.int32(5)
+        out = dev_i32(r)
+        assert out.dtype == "int32" and int(out) == 5
+        assert dev_u32(r).dtype == "uint32"      # cast, like jnp.uint32
+        assert int(dev_u32(jnp.uint32(9))) == 9
