@@ -217,12 +217,22 @@ class Pht:
     """The index object (ref: pht.h:268-510)."""
 
     def __init__(self, name: str, key_spec: Dict[str, int], dht,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 parent_insert: bool = True):
         self.name = INDEX_PREFIX + name
         self.canary = self.name + ".canary"
         self.key_spec = dict(key_spec)
         self.dht = dht
         self.rng = rng or random.Random()
+        # The reference's _get_real_prefix heuristic (insert at the
+        # parent while leaf+parent+sibling stay under the cap,
+        # pht.cpp:423-476) is insertion-ORDER-dependent and parks
+        # entries at interior nodes.  parent_insert=False pins inserts
+        # to the true leaf — the deterministic rule the device index
+        # (models/index.py) implements, and what the host↔device
+        # conformance test runs both sides under.  Default True keeps
+        # reference behavior.
+        self.parent_insert = bool(parent_insert)
         now = getattr(dht, "scheduler", None)
         self.cache = Cache(now.time if now is not None else _time.monotonic)
 
@@ -445,7 +455,10 @@ class Pht:
             if not check_split or (fp is not None and fp.size == kp.size):
                 real_insert(fp if fp is not None else kp, entry)
             elif len(vals) < MAX_NODE_ENTRY_COUNT:
-                self._get_real_prefix(fp, entry, real_insert)
+                if self.parent_insert:
+                    self._get_real_prefix(fp, entry, real_insert)
+                else:
+                    real_insert(fp if fp is not None else kp, entry)
             else:
                 self._split(fp, vals, entry, real_insert)
 

@@ -422,7 +422,7 @@ def _segment_rank(sorted_node: jax.Array, flag: jax.Array,
     return _segment_excl_sum(flag.astype(jnp.int32), first)
 
 
-@partial(jax.jit, static_argnames=("scfg",))
+@partial(jax.jit, static_argnames=("scfg",), donate_argnums=(0,))
 def _store_insert(store: SwarmStore, scfg: StoreConfig,
                   req_node: jax.Array, req_key: jax.Array,
                   req_val: jax.Array, req_seq: jax.Array,
@@ -687,7 +687,7 @@ def _announce_targets(swarm: Swarm, cfg: SwarmConfig, keys: jax.Array,
     return lookup(swarm, cfg, keys, rng)
 
 
-@partial(jax.jit, static_argnames=("cfg", "scfg"))
+@partial(jax.jit, static_argnames=("cfg", "scfg"), donate_argnums=(2,))
 def _announce_insert(alive: jax.Array, cfg: SwarmConfig,
                      store: SwarmStore,
                      scfg: StoreConfig, res_found: jax.Array,
@@ -1012,11 +1012,82 @@ def _mask_unowned(okf: jax.Array, found: jax.Array) -> jax.Array:
     return jnp.where(okf[:, None], found, -1)
 
 
+def pow2_width(m: int, floor: int) -> int:
+    """Smallest power of two ≥ ``max(m, floor)`` — compacted batch
+    widths round up to a pow2 rung so the number of jit
+    specializations of the downstream lookup/insert programs stays at
+    ~log2 of the largest batch (the republish sweep compaction and the
+    index engine's probe/put padding share this rule)."""
+    return max(floor, 1 << max(0, (m - 1)).bit_length())
+
+
+# Smallest compacted maintenance width: lets a near-empty store sweep
+# at trivial width without minting single-digit-width programs.
+_REPUB_COMPACT_FLOOR = 256
+
+
+@partial(jax.jit, static_argnames=("cfg", "scfg"))
+def _repub_live(alive: jax.Array, store: SwarmStore,
+                node_idx: jax.Array, cfg: SwarmConfig,
+                scfg: StoreConfig):
+    """Live-first ordering of a maintenance batch — the cheap pre-pass
+    the sweep compaction keys on (PR-6 ledger finding: the sweep
+    priced the full ``N·slots`` lookup batch for ~32× fewer live
+    values).  Returns ``(order [M·S] int32, n_live)``: a STABLE
+    permutation of the flat (node, slot) rows with live rows (alive
+    republisher & used slot) first."""
+    n_safe = jnp.clip(node_idx, 0, cfg.n_nodes - 1)
+    ok = ((node_idx >= 0)[:, None] & alive[n_safe][:, None]
+          & store.used[n_safe]).reshape(-1)
+    order = jnp.argsort(~ok, stable=True).astype(jnp.int32)
+    return order, jnp.sum(ok.astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("cfg", "scfg"))
+def _repub_extract_rows(alive: jax.Array, store: SwarmStore,
+                        node_idx: jax.Array, rows: jax.Array,
+                        cfg: SwarmConfig, scfg: StoreConfig):
+    """Store-row extract for a COMPACTED maintenance subset: ``rows
+    [W]`` indexes the flat ``[M·slots]`` batch of ``node_idx``.  Same
+    outputs as :func:`_repub_extract`, at width W."""
+    s = scfg.slots
+    node = node_idx[jnp.clip(rows // s, 0, node_idx.shape[0] - 1)]
+    n_safe = jnp.clip(node, 0, cfg.n_nodes - 1)
+    slot = rows % s
+    ok = (node >= 0) & alive[n_safe] & store.used[n_safe, slot]
+    srow = n_safe * s + slot
+    keys = _key_rows(store.keys, srow)
+    vals = store.vals[n_safe, slot]
+    seqs = store.seqs[n_safe, slot]
+    sizes = store.sizes[n_safe, slot]
+    ttls = store.ttls[n_safe, slot]
+    w = scfg.payload_words
+    if w:
+        payloads = _pl_gather(store.payload, srow, w)
+    else:
+        payloads = jnp.zeros((rows.shape[0], 0), jnp.uint32)
+    return keys, vals, seqs, sizes, ttls, payloads, ok
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _repub_writeback(rows: jax.Array, replicas: jax.Array,
+                     hops: jax.Array, done: jax.Array, m: int):
+    """Scatter a compacted sweep's per-row report back to the full
+    ``[M·slots]`` batch shape (callers see the same report layout
+    compacted or not).  Unselected rows are dead/empty: 0 replicas,
+    0 hops, trivially done."""
+    rep = jnp.zeros((m,), replicas.dtype).at[rows].set(replicas)
+    hp = jnp.zeros((m,), hops.dtype).at[rows].set(hops)
+    dn = jnp.ones((m,), bool).at[rows].set(done)
+    return rep, hp, dn
+
+
 def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                    scfg: StoreConfig, node_idx: jax.Array, now,
                    rng: jax.Array, drop_frac: float = 0.0,
                    drop_key: jax.Array | None = None,
-                   stats: dict | None = None
+                   stats: dict | None = None,
+                   compact: bool = True
                    ) -> Tuple[SwarmStore, AnnounceReport]:
     """Chosen nodes re-announce every value they hold — the storage
     maintenance that restores replication after churn
@@ -1038,11 +1109,35 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     repub-profile attribution (same contract as ``lookup``'s
     ``stats["time_phases"]``: the barriers de-pipeline the device
     queue, so attribution passes are SEPARATE from timed sweeps).
+
+    ``compact`` (default on — the PR-6 ledger finding's fix): gather
+    the LIVE maintenance rows into a dense power-of-two prefix BEFORE
+    the lookup phase, so a sweep prices ~n_live lookups instead of
+    the full ``M·slots`` batch (the r06 profile paid the full batch
+    for 32× fewer live values).  The per-row report is scattered back
+    to the full batch shape, so callers see identical layout either
+    way; the extract phase window absorbs the compaction (one
+    live-count readback per sweep).  ``compact=False`` keeps the
+    full-width sweep for A/B.
     """
     timing = bool(stats) and stats.get("time_phases")
     t0 = time.perf_counter() if timing else 0.0
-    keys, vals, seqs, sizes, ttls, payloads, okf = _repub_extract(
-        swarm.alive, store, node_idx, cfg, scfg)
+    m = node_idx.shape[0] * scfg.slots
+    rows = None
+    if compact:
+        order, nlive_d = _repub_live(swarm.alive, store, node_idx,
+                                     cfg, scfg)
+        n_live = int(jax.device_get(nlive_d))
+        wdt = min(m, pow2_width(n_live, _REPUB_COMPACT_FLOOR))
+        if wdt < m:
+            rows = order[:wdt]
+    if rows is not None:
+        keys, vals, seqs, sizes, ttls, payloads, okf = \
+            _repub_extract_rows(swarm.alive, store, node_idx, rows,
+                                cfg, scfg)
+    else:
+        keys, vals, seqs, sizes, ttls, payloads, okf = _repub_extract(
+            swarm.alive, store, node_idx, cfg, scfg)
     if timing:
         jax.block_until_ready((keys, vals, seqs, payloads, okf))
         t1 = time.perf_counter()
@@ -1058,10 +1153,17 @@ def republish_from(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                                               scfg, found, keys, vals,
                                               seqs, dev_u32(now),
                                               sizes, ttls, payloads)
+    hops, done = res.hops, res.done
+    if rows is not None:
+        replicas, hops, done = _repub_writeback(rows, replicas, hops,
+                                                done, m)
     if timing:
         jax.block_until_ready((store, replicas))
         t3 = time.perf_counter()
         stats["insert_s"] = t3 - t2
         stats["sweep_total_s"] = t3 - t0
-    return store, AnnounceReport(replicas=replicas, hops=res.hops,
-                                 done=res.done, trace=trace)
+    if stats is not None:
+        stats["lookup_rows"] = int(keys.shape[0])
+        stats["batch_rows"] = m
+    return store, AnnounceReport(replicas=replicas, hops=hops,
+                                 done=done, trace=trace)

@@ -86,10 +86,14 @@ ENTRY_POINTS: tuple = (
     ("opendht_tpu.models.serve", "_scatter_admission", (0,)),
     ("opendht_tpu.models.serve", "_snapshot", ()),
     ("opendht_tpu.models.serve", "_expire_slots", (0,)),
-    ("opendht_tpu.models.storage", "_store_insert", ()),
-    ("opendht_tpu.models.storage", "_announce_insert", ()),
+    ("opendht_tpu.models.storage", "_store_insert", (0,)),
+    ("opendht_tpu.models.storage", "_announce_insert", (2,)),
     ("opendht_tpu.models.storage", "_get_probe", ()),
     ("opendht_tpu.models.storage", "_listen_insert", ()),
+    ("opendht_tpu.models.index", "_linearize_batch", ()),
+    ("opendht_tpu.models.index", "_trie_node_hash", ()),
+    ("opendht_tpu.models.index", "_pack_entry_payloads", ()),
+    ("opendht_tpu.ops.sha1", "sha1_one_block", ()),
     ("opendht_tpu.models.monitor", "fold_sweep", (0,)),
     ("opendht_tpu.parallel.sharded", "_sharded_lookup_while", ()),
     ("opendht_tpu.parallel.sharded", "_sharded_lookup_init", ()),
@@ -102,6 +106,7 @@ ENTRY_POINTS: tuple = (
      (0, 1)),
     ("opendht_tpu.parallel.sharded", "_sharded_rebalance_resize",
      (0, 1)),
+    ("opendht_tpu.parallel.sharded_storage", "_sharded_insert", (2,)),
 )
 
 # jits whose compile cache sizes bound the round loop's specializations

@@ -1,0 +1,118 @@
+"""Batched single-block SHA-1 — device twin of ``InfoHash.get``.
+
+The PHT secondary index locates a trie node at ``SHA-1(prefix content
+‖ size byte)`` (``Prefix.hash``, indexation/pht.py — ref pht.h:103-107).
+The device index (:mod:`opendht_tpu.models.index`) must derive the SAME
+160-bit store keys for a ``[B]`` batch of prefixes, or the host and
+device views of one index stop being interchangeable — so the hash is
+not approximated or replaced with a cheaper mix: it is SHA-1 itself,
+vectorized.
+
+A trie-node message is at most ``prefix_bytes + 1 ≤ 33`` bytes, which
+always fits ONE padded 64-byte SHA-1 block (≤ 55 bytes of payload), so
+the kernel only implements the single-block compression: 80 rounds of
+uint32 rotate/xor/add over ``[B]``-shaped lanes — embarrassingly
+batch-parallel, no per-row control flow.  Equality with ``hashlib``
+(and hence ``InfoHash.get``) is pinned in ``tests/test_index.py``.
+
+The digest comes back as ``[B, 5] uint32`` big-endian words — exactly
+the packed-limb form of an :class:`~opendht_tpu.utils.infohash.InfoHash`
+(limb 0 = digest bytes 0-3), so the result IS the storage key the
+batched announce/get kernels consume.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_U32 = jnp.uint32
+_MASK32 = 0xFFFFFFFF
+
+
+def _rotl(x: jax.Array, n: int) -> jax.Array:
+    return (x << _U32(n)) | (x >> _U32(32 - n))
+
+
+@jax.jit
+def sha1_one_block(msg: jax.Array) -> jax.Array:
+    """SHA-1 of one already-padded 64-byte block per row.
+
+    ``msg [..., 16] uint32``: the block as big-endian words — the
+    caller has already appended the 0x80 terminator and the 64-bit bit
+    length (:func:`sha1_pad_le55` builds it from raw bytes).  Returns
+    ``[..., 5] uint32`` big-endian digest words (= InfoHash limbs).
+
+    The 80-round schedule is a static Python unroll of uint32
+    elementwise ops (adds wrap mod 2³² natively in uint32): every op is
+    ``[B]``-wide, so XLA fuses the whole compression into one pass per
+    batch with no gather/scatter at all.
+    """
+    w = [msg[..., i] for i in range(16)]
+    for i in range(16, 80):
+        w.append(_rotl(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1))
+
+    shape = msg.shape[:-1]
+    a = jnp.full(shape, 0x67452301, _U32)
+    b = jnp.full(shape, 0xEFCDAB89, _U32)
+    c = jnp.full(shape, 0x98BADCFE, _U32)
+    d = jnp.full(shape, 0x10325476, _U32)
+    e = jnp.full(shape, 0xC3D2E1F0, _U32)
+    h0, h1, h2, h3, h4 = a, b, c, d, e
+
+    for i in range(80):
+        if i < 20:
+            f = (b & c) | (~b & d)
+            k = _U32(0x5A827999)
+        elif i < 40:
+            f = b ^ c ^ d
+            k = _U32(0x6ED9EBA1)
+        elif i < 60:
+            f = (b & c) | (b & d) | (c & d)
+            k = _U32(0x8F1BBCDC)
+        else:
+            f = b ^ c ^ d
+            k = _U32(0xCA62C1D6)
+        tmp = _rotl(a, 5) + f + e + k + w[i]
+        e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
+
+    return jnp.stack([h0 + a, h1 + b, h2 + c, h3 + d, h4 + e], axis=-1)
+
+
+def sha1_pad_le55(content: jax.Array, n_bytes: jax.Array) -> jax.Array:
+    """Pad per-row variable-length messages (≤ 55 bytes) into one SHA-1
+    block.
+
+    ``content [..., C] uint32`` holds the message BYTES packed
+    big-endian into words (byte ``k`` of row r is bits
+    ``[8·(3-k%4), 8·(4-k%4))`` of ``content[r, k//4]``; bytes at or
+    past ``n_bytes`` must already be zero); ``n_bytes [...]`` is the
+    per-row message byte length, which must satisfy ``n_bytes ≤ 55``
+    (single-block padding) and ``n_bytes ≤ 4·C``.  Returns the padded
+    ``[..., 16] uint32`` block for :func:`sha1_one_block`.
+
+    The 0x80 terminator lands at byte ``n_bytes`` and the 64-bit bit
+    length in the last two words — all as masked elementwise selects
+    over the 14 payload words, so rows with different lengths share one
+    compiled program.
+    """
+    c_words = content.shape[-1]
+    nb = n_bytes.astype(jnp.int32)
+    words = []
+    for wi in range(14):
+        if wi < c_words:
+            wv = content[..., wi]
+        else:
+            wv = jnp.zeros(nb.shape, _U32)
+        # 0x80 terminator: byte index nb sits in word nb//4 at byte
+        # lane nb%4.
+        in_word = (nb // 4) == wi
+        lane = jnp.clip(nb - 4 * wi, 0, 3)
+        term = jnp.where(in_word,
+                         _U32(0x80) << (_U32(8) * (3 - lane).astype(_U32)),
+                         _U32(0))
+        words.append(wv | term)
+    bitlen = (nb.astype(_U32) * _U32(8))
+    words.append(jnp.zeros(nb.shape, _U32))          # length high word
+    words.append(bitlen)                             # length low word
+    return jnp.stack(words, axis=-1)

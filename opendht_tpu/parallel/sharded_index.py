@@ -1,0 +1,51 @@
+"""Mesh-sharded twin of the device PHT index.
+
+:class:`~opendht_tpu.models.index.DeviceIndex` drives the trie through
+the generic batched get/put surface, so the sharded twin only rebinds
+those two engine ops onto the routed mesh formulations
+(:func:`~opendht_tpu.parallel.sharded_storage.sharded_get` /
+:func:`~opendht_tpu.parallel.sharded_storage.sharded_announce`): the
+trie encoding, the leaf walk, splits and range scans are byte-for-byte
+the same code — host, single-chip and mesh views of one stored trie.
+
+Probe/put batches are already padded to power-of-two widths ≥ 16 by the
+base engine, so every batch divides the (≤ 8-way) mesh; capacity-bound
+``all_to_all`` drops behave exactly as on the storage path — a dropped
+canary/entry replica costs replication for the round and heals on the
+next maintenance sweep.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+from ..models.index import DeviceIndex, IndexSpec
+from ..models.storage import StoreConfig, SwarmStore
+from ..models.swarm import Swarm, SwarmConfig
+from .sharded_storage import sharded_announce, sharded_get
+
+
+class ShardedDeviceIndex(DeviceIndex):
+    """The device PHT engine with its get/put ops routed over the
+    1-D swarm mesh (node-sharded store + routed lookups)."""
+
+    def __init__(self, swarm: Swarm, cfg: SwarmConfig,
+                 store: SwarmStore, scfg: StoreConfig, spec: IndexSpec,
+                 mesh: Mesh, capacity_factor: float = 4.0,
+                 seed: int = 0):
+        super().__init__(swarm, cfg, store, scfg, spec, seed=seed)
+        self.mesh = mesh
+        self.capacity_factor = capacity_factor
+
+    def _get_raw(self, keys: jax.Array):
+        res = sharded_get(self.swarm, self.cfg, self.store, self.scfg,
+                          keys, self._next_key(), self.mesh,
+                          self.capacity_factor)
+        return res.hit, res.val, res.payload
+
+    def _put_raw(self, keys, vals, seqs, payloads) -> None:
+        self.store, _rep = sharded_announce(
+            self.swarm, self.cfg, self.store, self.scfg, keys, vals,
+            seqs, 0, self._next_key(), self.mesh,
+            capacity_factor=self.capacity_factor, payloads=payloads)

@@ -412,7 +412,8 @@ def shard_store(store: SwarmStore, mesh: Mesh) -> SwarmStore:
 
 @partial(jax.jit,
          static_argnames=("cfg", "scfg", "mesh", "capacity_factor",
-                          "probe", "full_capacity_factor"))
+                          "probe", "full_capacity_factor"),
+         donate_argnums=(2,))
 def _sharded_insert(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
                     scfg: StoreConfig, found, keys, vals, seqs, sizes,
                     ttls, payloads, now, mesh: Mesh,

@@ -36,6 +36,12 @@ QUALITY floor that gates on any platform: coverage must not drop below
 no regression gate), and a monitor row's measured ``detection_lag_max``
 must stay within the recorded row's stated sweep-period bound.
 
+INDEX rows (``swarm_index_scan_entries_per_sec`` — ``--mode index``
+or its ``swarm_index_trace`` artifact) keep the same-platform rate
+floor and add any-platform EXACTNESS gates: ``scan_recall`` must be
+exactly 1.0, ``scan_exact`` must hold, and ``overfull_drops`` must
+not grow past the recorded row's.
+
 Exit 0 on pass; exit 1 with one line per violation.
 """
 
@@ -51,7 +57,7 @@ def _load_row(path: str) -> dict:
     with open(path) as f:
         obj = json.load(f)
     if obj.get("kind") in ("swarm_lookup_trace", "swarm_serve_trace",
-                           "swarm_monitor_trace"):
+                           "swarm_monitor_trace", "swarm_index_trace"):
         obj = obj["bench"]                           # ...artifacts
     if "value" not in obj or "metric" not in obj:
         raise ValueError(f"{path}: no BENCH row found (need "
@@ -118,6 +124,21 @@ def check_bench_rows(cur: dict, base: dict,
         print(f"check_bench: rate comparison SKIPPED — platform "
               f"{cur.get('platform')!r} vs baseline "
               f"{base.get('platform')!r} (quality gates still apply)")
+
+    # Index rows (swarm_index_scan_entries_per_sec): exactness is a
+    # hard quality gate on ANY platform — a scan that got faster by
+    # dropping entries (or inventing them) must never gate green.
+    sr = cur.get("scan_recall")
+    if sr is not None and sr != 1.0:
+        errs.append(f"scan_recall {sr} != 1.0 — range scans are not "
+                    f"exact vs the host-PHT oracle")
+    if cur.get("scan_exact") is False:
+        errs.append("scan_exact false — scans returned entries the "
+                    "oracle does not hold")
+    od = cur.get("overfull_drops")
+    ob = base.get("overfull_drops")
+    if od is not None and ob is not None and od > ob:
+        errs.append(f"overfull_drops grew: {od} vs baseline {ob}")
 
     r_cur, r_base = cur.get("recall_at_8"), base.get("recall_at_8")
     if r_cur is not None and r_base is not None \

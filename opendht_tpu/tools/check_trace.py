@@ -581,6 +581,113 @@ def check_monitor_obj(obj: dict) -> List[str]:
     return errs
 
 
+def check_index_obj(obj: dict) -> List[str]:
+    """Validate a ``swarm_index_trace`` artifact (``bench.py --mode
+    index --index-out``).  All violations (empty = pass):
+
+    a. **leaf capacity** — no leaf may hold more than 16 entries (the
+       reference's ``MAX_NODE_ENTRY_COUNT`` is STRUCTURAL in the
+       device encoding: a 17th slot key does not exist), and the
+       occupancy histogram must account for every leaf;
+    b. **split accounting conservation** — a binary trie grown only by
+       splits satisfies ``n_leaves == 1 + split_levels``, and every
+       distinct inserted entry is either in a leaf or counted as a
+       structural overfull drop (``entries_in_leaves + overfull_drops
+       == entries_distinct``);
+    c. **exact recall** — the range scans must return EXACTLY the
+       sequential host-PHT oracle's entry sets: recall 1.0 AND zero
+       extras (a scan that pads its recall with spurious entries must
+       fail, not average out);
+    d. **probe-round bound** — the measured leaf-walk rounds must sit
+       within the artifact's stated binary-search bound, which must
+       itself equal the one the prefix width implies
+       (``2·(⌈log2(prefix_bits+1)⌉+1)``, the hint-miss-restart bound
+       of ``DeviceIndex.leaf_search``).
+    """
+    import math as _math
+
+    errs: List[str] = []
+    for field in ("kind", "bench", "index"):
+        if field not in obj:
+            errs.append(f"missing top-level field {field!r}")
+    if errs:
+        return errs
+    bench, ix = obj["bench"], obj["index"]
+    for f in ("prefix_bits", "probe_round_bound", "walk_rounds_max",
+              "entries_distinct", "entries_in_leaves",
+              "overfull_drops", "n_leaves", "split_levels"):
+        if not (_num(ix.get(f)) and ix[f] >= 0):
+            errs.append(f"index field {f} missing/negative: "
+                        f"{ix.get(f)!r}")
+    if errs:
+        return errs
+
+    # (a) leaf capacity + histogram accounting
+    occ_max = ix.get("leaf_occupancy_max")
+    hist = ix.get("leaf_occupancy_hist")
+    if not (_num(occ_max) and 0 <= occ_max <= 16):
+        errs.append(f"leaf_occupancy_max {occ_max!r} outside [0, 16] "
+                    f"— a leaf exceeded MAX_NODE_ENTRY_COUNT")
+    if not (isinstance(hist, list) and len(hist) == 17
+            and all(_num(v) and v >= 0 for v in hist)):
+        errs.append(f"leaf_occupancy_hist malformed: {hist!r}")
+    else:
+        if sum(hist) != ix["n_leaves"]:
+            errs.append(f"leaf_occupancy_hist sums to {sum(hist)} for "
+                        f"{ix['n_leaves']} leaves")
+        deepest = max((i for i, v in enumerate(hist) if v), default=0)
+        if _num(occ_max) and deepest != occ_max:
+            errs.append(f"leaf_occupancy_max {occ_max} != histogram "
+                        f"max occupied bin {deepest}")
+        if sum(i * v for i, v in enumerate(hist)) \
+                != ix["entries_in_leaves"]:
+            errs.append("entries_in_leaves disagrees with the "
+                        "occupancy histogram")
+
+    # (b) split conservation
+    if ix["n_leaves"] != 1 + ix["split_levels"]:
+        errs.append(f"n_leaves {ix['n_leaves']} != 1 + split_levels "
+                    f"{ix['split_levels']} (split accounting does not "
+                    f"conserve)")
+    if ix["entries_in_leaves"] + ix["overfull_drops"] \
+            != ix["entries_distinct"]:
+        errs.append(
+            f"entries_in_leaves {ix['entries_in_leaves']} + "
+            f"overfull_drops {ix['overfull_drops']} != "
+            f"entries_distinct {ix['entries_distinct']} — entries "
+            f"leaked or were double-stored")
+    if ix.get("oracle_agrees") is not True:
+        errs.append("oracle_agrees is not true — the device trie "
+                    "diverged from the sequential host-PHT oracle")
+
+    # (c) exact recall
+    scans = ix.get("scans") or {}
+    if scans.get("recall") != 1.0:
+        errs.append(f"scan recall {scans.get('recall')!r} != 1.0")
+    if scans.get("exact") is not True:
+        errs.append("scan exact is not true (extras "
+                    f"{scans.get('extras')!r})")
+    if _num(scans.get("extras")) and scans["extras"] != 0:
+        errs.append(f"scans returned {scans['extras']} entries the "
+                    f"oracle does not hold")
+    if bench.get("scan_recall") != scans.get("recall"):
+        errs.append(f"bench scan_recall {bench.get('scan_recall')!r} "
+                    f"!= artifact recall {scans.get('recall')!r}")
+
+    # (d) probe-round bound, recomputed from the prefix width
+    want_bound = 2 * (int(_math.ceil(
+        _math.log2(ix["prefix_bits"] + 1))) + 1)
+    if ix["probe_round_bound"] != want_bound:
+        errs.append(f"probe_round_bound {ix['probe_round_bound']} != "
+                    f"derived 2*(ceil(log2(prefix_bits+1))+1) = "
+                    f"{want_bound}")
+    if ix["walk_rounds_max"] > ix["probe_round_bound"]:
+        errs.append(f"walk_rounds_max {ix['walk_rounds_max']} exceeds "
+                    f"the binary-search bound "
+                    f"{ix['probe_round_bound']}")
+    return errs
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) != 1:
@@ -615,6 +722,18 @@ def main(argv=None) -> int:
         print(f"check_trace: monitor OK — {len(sweeps)} sweeps, "
               f"final coverage {sweeps[-1]['coverage']:.4f}, "
               f"hop tv {fid['tv']:.4f} (band {fid['band_tv']})")
+        return 0
+    if obj.get("kind") == "swarm_index_trace":
+        errs = check_index_obj(obj)
+        if errs:
+            for e in errs:
+                print(f"check_trace: {e}")
+            return 1
+        ix = obj["index"]
+        print(f"check_trace: index OK — {ix['n_leaves']} leaves / "
+              f"{ix['entries_in_leaves']} entries, scan recall "
+              f"{ix['scans']['recall']}, walk rounds "
+              f"{ix['walk_rounds_max']} <= {ix['probe_round_bound']}")
         return 0
     if obj.get("kind") == "cost_ledger":
         errs = check_ledger_obj(obj)

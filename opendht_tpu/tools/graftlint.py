@@ -139,6 +139,8 @@ REGISTRY_MODULES = {
     "opendht_tpu.models.storage": "opendht_tpu/models/storage.py",
     "opendht_tpu.models.serve": "opendht_tpu/models/serve.py",
     "opendht_tpu.models.monitor": "opendht_tpu/models/monitor.py",
+    "opendht_tpu.models.index": "opendht_tpu/models/index.py",
+    "opendht_tpu.ops.sha1": "opendht_tpu/ops/sha1.py",
     "opendht_tpu.parallel.sharded": "opendht_tpu/parallel/sharded.py",
     "opendht_tpu.parallel.sharded_storage":
         "opendht_tpu/parallel/sharded_storage.py",
@@ -1486,14 +1488,33 @@ def _build_workloads():
         # _store_insert standalone (it is inlined inside
         # _announce_insert on the natural path)
         m = 32
-        stg._store_insert(
+        store = stg._store_insert(
             store, scfg,
             jnp.arange(m, dtype=jnp.int32),
             keys[:m], vals[:m], seqs[:m],
             jnp.arange(m, dtype=jnp.int32), dev_u32(0),
             jnp.ones((m,), jnp.uint32),
             jnp.zeros((m,), jnp.uint32),
-            pls[:m])
+            pls[:m])[0]
+
+    def index_kernels():
+        # The device-PHT encoding jits: linearize → trie-node SHA-1 →
+        # entry payload pack, plus the batched SHA-1 standalone (it is
+        # inlined inside _trie_node_hash on the natural path).
+        from ..models import index as ix
+        from ..ops.sha1 import sha1_one_block, sha1_pad_le55
+        spec = ix.IndexSpec.from_key_spec("lint", {"id": 4})
+        fb, fl = ix.fields_to_arrays(
+            spec, [{"id": b"ab"}, {"id": b"cd"}])
+        bits = ix._linearize_batch(spec, jnp.asarray(fb),
+                                   jnp.asarray(fl))
+        ix._trie_node_hash(spec, bits, jnp.zeros((2,), jnp.int32))
+        ix._pack_entry_payloads(
+            spec, jnp.zeros((2, 5), jnp.uint32),
+            jnp.arange(2, dtype=jnp.uint32), bits)
+        sha1_one_block(sha1_pad_le55(
+            jnp.zeros((2, 3), jnp.uint32),
+            jnp.full((2,), 9, jnp.int32)))
 
     def sharded_engines():
         import jax as _jax
@@ -1523,6 +1544,17 @@ def _build_workloads():
             st2, order_r, cfg8, mesh, 128)
         sh._sharded_rebalance_resize(fullr, orderr, subr, cfg8, mesh,
                                      64)
+        # routed storage insert (_sharded_insert — donated store)
+        from ..parallel import sharded_storage as shst
+        scfg8 = stg.StoreConfig(slots=4, listen_slots=2,
+                                max_listeners=64, payload_words=2)
+        store8 = shst.sharded_empty_store(cfg8.n_nodes, scfg8, mesh)
+        store8, _rep = shst.sharded_announce(
+            sw8, cfg8, store8, scfg8, tg[:256],
+            jnp.arange(256, dtype=jnp.uint32) + 1,
+            jnp.ones((256,), jnp.uint32), 0, key, mesh,
+            payloads=jax.random.bits(jax.random.PRNGKey(12), (256, 2),
+                                     jnp.uint32))
 
     def monitor_sweep():
         from ..models import monitor as mon
@@ -1534,6 +1566,7 @@ def _build_workloads():
         "compaction-plumbing": compaction_plumbing,
         "serve-engine": serve_engine,
         "storage-paths": storage_paths,
+        "index-kernels": index_kernels,
         "monitor-sweep": monitor_sweep,
         "sharded-engines": sharded_engines,
     }
@@ -1689,9 +1722,18 @@ def run_plane_strict(root: str) -> List[Finding]:
 
 def _strict_storage(stg, swarm, cfg, store0, scfg, keys, vals, seqs,
                     rngs, lkeys, lregs, ridx):
+    import jax
+    import jax.numpy as jnp
+
     r_ann, r_get, r_lst, r_rep = rngs
-    store, _ = stg.announce(swarm, cfg, store0, scfg, keys, vals,
-                            seqs, 0, r_ann)
+    # announce CONSUMES its input store (donated) — each replay of
+    # this workload must hand it a fresh copy or the warm pass leaves
+    # the guarded pass a deleted buffer.  (Do not rely on debug_nans
+    # suppressing donation: the replay must exercise the real donated
+    # path.)  A device->device copy, legal under the transfer guard.
+    store, _ = stg.announce(swarm, cfg,
+                            jax.tree_util.tree_map(jnp.array, store0),
+                            scfg, keys, vals, seqs, 0, r_ann)
     stg.get_values(swarm, cfg, store, scfg, keys, r_get)
     stg.listen_at(swarm, cfg, store, scfg, lkeys, lregs, r_lst, 0)
     stg.republish_from(swarm, cfg, store, scfg, ridx, 1, r_rep)

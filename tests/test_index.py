@@ -1,0 +1,394 @@
+"""Device-native PHT index: encoding parity, engine↔oracle↔host
+conformance, range-scan exactness (models/index.py, ops/sha1.py).
+
+The subsystem's seed-identity pin (the test_compaction pattern): the
+SAME key set inserted three ways — sequential in-memory oracle
+(:class:`PhtOracle`), batched device engine (:class:`DeviceIndex`),
+and the UNMODIFIED host :class:`Pht` driven over the device store
+(:class:`StoreDht`, ``parent_insert=False``) — must yield identical
+leaf prefixes and per-leaf entry sets, and each side must be able to
+read a trie the other built.
+"""
+
+import hashlib
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opendht_tpu.indexation.pht import (
+    MAX_NODE_ENTRY_COUNT, Pht, Prefix,
+)
+from opendht_tpu.models.index import (
+    CANARY_TOKEN, DeviceIndex, IndexSpec, PhtOracle, StoreDht,
+    _linearize_batch, _trie_node_hash, fields_to_arrays,
+)
+from opendht_tpu.models.storage import StoreConfig, empty_store
+from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+from opendht_tpu.ops.sha1 import sha1_one_block, sha1_pad_le55
+from opendht_tpu.utils.infohash import InfoHash
+
+SPEC = IndexSpec.from_key_spec("conf", {"id": 4})
+CFG = SwarmConfig.for_nodes(1024)
+SCFG = StoreConfig(slots=24, listen_slots=1, max_listeners=64,
+                   payload_words=SPEC.payload_words)
+
+
+@pytest.fixture(scope="module")
+def swarm():
+    return build_swarm(jax.random.PRNGKey(0), CFG)
+
+
+def _keyset():
+    """24 entries over 16 distinct 2-byte keys (8 duplicated with a
+    second vid): the shared-prefix density forces root splits down
+    several levels while staying splittable (≤ 2 entries per exact
+    key)."""
+    rng = random.Random(11)
+    raw = [bytes([a, b]) for a in b"ab" for b in b"abcdefgh"]
+    raw = raw + rng.sample(raw, 8)
+    keys = [{"id": k} for k in raw]
+    ehash = [InfoHash.get(f"e{i}") for i in range(len(raw))]
+    evid = list(range(len(raw)))
+    return keys, ehash, evid
+
+
+def _entry_rows(ehash):
+    return np.stack([np.frombuffer(bytes(h), dtype=">u4")
+                     for h in ehash]).astype(np.uint32)
+
+
+def _oracle_of(ix, keys, ehash, evid):
+    orc = PhtOracle(ix.spec)
+    bits = ix.linearize(keys)
+    for i in range(len(keys)):
+        orc.insert(bits[i], bytes(ehash[i]), evid[i])
+    return orc
+
+
+@pytest.fixture(scope="module")
+def built(swarm):
+    """Device-built index + matching oracle (shared by the read-side
+    tests — the engine's own build is proven against the oracle once
+    here)."""
+    ix = DeviceIndex(swarm, CFG, empty_store(CFG.n_nodes, SCFG), SCFG,
+                     SPEC, seed=3)
+    keys, ehash, evid = _keyset()
+    ix.insert_batch(keys, _entry_rows(ehash),
+                    np.asarray(evid, np.uint32))
+    orc = _oracle_of(ix, keys, ehash, evid)
+    return ix, orc, keys, ehash, evid
+
+
+# --------------------------------------------------------------------------
+# encoding parity: SHA-1, linearize, trie-node hash
+# --------------------------------------------------------------------------
+
+class TestEncodingParity:
+    def test_sha1_matches_hashlib(self):
+        rng = random.Random(5)
+        msgs = [bytes(rng.getrandbits(8) for _ in range(n))
+                for n in list(range(0, 56, 5)) + [55]]
+        c = max((len(m) + 3) // 4 for m in msgs)
+        content = np.zeros((len(msgs), c), np.uint32)
+        for i, m in enumerate(msgs):
+            padded = m + bytes(4 * c - len(m))
+            content[i] = np.frombuffer(padded, dtype=">u4")
+        out = np.asarray(sha1_one_block(sha1_pad_le55(
+            jnp.asarray(content),
+            jnp.asarray([len(m) for m in msgs], jnp.int32))))
+        for i, m in enumerate(msgs):
+            want = hashlib.sha1(m).digest()
+            got = out[i].astype(">u4").tobytes()
+            assert got == want, (i, len(m))
+
+    def test_linearize_matches_host_pht(self):
+        class _NoDht:
+            pass
+        spec = IndexSpec.from_key_spec("two", {"a": 3, "b": 5})
+        pht = Pht("two", {"a": 3, "b": 5}, _NoDht())
+        rng = random.Random(7)
+        keys = [{"a": bytes(rng.getrandbits(8)
+                            for _ in range(rng.randint(0, 3))),
+                 "b": bytes(rng.getrandbits(8)
+                            for _ in range(rng.randint(0, 5)))}
+                for _ in range(32)]
+        fb, fl = fields_to_arrays(spec, keys)
+        dev = np.asarray(_linearize_batch(spec, jnp.asarray(fb),
+                                          jnp.asarray(fl)))
+        for i, k in enumerate(keys):
+            host = pht.linearize(k)
+            want = host.content + bytes(spec.prefix_words * 4
+                                        - len(host.content))
+            assert dev[i].astype(">u4").tobytes() == want, k
+            assert host.size == spec.prefix_bits
+
+    def test_trie_node_hash_matches_prefix_hash(self):
+        spec = SPEC
+        rng = random.Random(9)
+        rows, depths, want = [], [], []
+        for _ in range(40):
+            content = bytes(rng.getrandbits(8)
+                            for _ in range(spec.prefix_bytes))
+            d = rng.randint(0, spec.prefix_bits)
+            full = Prefix(content, spec.prefix_bits)
+            rows.append(np.frombuffer(
+                content + bytes(spec.prefix_words * 4
+                                - len(content)), dtype=">u4"))
+            depths.append(d)
+            want.append(bytes(full.get_prefix(d).hash()))
+        dev = np.asarray(_trie_node_hash(
+            spec, jnp.asarray(np.stack(rows).astype(np.uint32)),
+            jnp.asarray(np.asarray(depths, np.int32))))
+        for i in range(len(rows)):
+            assert dev[i].astype(">u4").tobytes() == want[i], depths[i]
+
+    def test_spec_too_wide_raises(self):
+        with pytest.raises(ValueError, match="too wide"):
+            IndexSpec.from_key_spec("wide", {"a": 16, "b": 16})
+
+
+# --------------------------------------------------------------------------
+# device engine vs the sequential oracle
+# --------------------------------------------------------------------------
+
+class TestDeviceEngine:
+    def test_trie_matches_oracle(self, built):
+        ix, orc, *_ = built
+        dev_leaves, _interior = ix.trie_snapshot()
+        orc_leaves = orc.leaves()
+        assert set(dev_leaves) == set(orc_leaves)
+        for k in dev_leaves:
+            assert dev_leaves[k] == orc_leaves[k], k
+        assert ix.stats["splits"] > 0          # the set exercised splits
+        assert ix.stats["overfull_drops"] == 0
+
+    def test_leaf_occupancy_cap(self, built):
+        ix, *_ = built
+        leaves, _ = ix.trie_snapshot()
+        assert all(len(v) <= MAX_NODE_ENTRY_COUNT
+                   for v in leaves.values())
+
+    def test_probe_rounds_within_bound(self, built):
+        ix, *_ = built
+        assert 0 < ix.stats["walk_rounds_max"] <= SPEC.probe_round_bound
+
+    def test_lookup_batch_exact(self, built):
+        ix, _orc, keys, ehash, evid = built
+        _depth, ents = ix.lookup_batch(keys)
+        for i in range(len(keys)):
+            assert (bytes(ehash[i]), evid[i]) in ents[i], i
+        # And nothing from OTHER keys leaks in (exact semantics).
+        bits = ix.linearize(keys)
+        by_key = {}
+        for i in range(len(keys)):
+            by_key.setdefault(bytes(bits[i].tobytes()), set()).add(
+                (bytes(ehash[i]), evid[i]))
+        for i in range(len(keys)):
+            assert set(ents[i]) == by_key[bytes(bits[i].tobytes())], i
+
+    def test_range_query_exact_fresh_reader(self, swarm, built):
+        """A FRESH reader (depth hint 0) over the built store: the
+        leaf walk must self-correct past its hint and the range scan
+        return the exact oracle entry set."""
+        ix, orc, *_ = built
+        reader = DeviceIndex(swarm, CFG, ix.store, SCFG, SPEC, seed=9)
+        lo = reader.linearize([{"id": b"a"}])[0]
+        hi = reader.linearize([{"id": b"b"}])[0]
+        res, leaves = reader.range_query(lo[None, :], hi[None, :])
+        want = orc.entries_in_range(lo, hi)
+        assert set(res[0]) == want
+        assert len(want) > 0
+        assert int(leaves[0]) >= 1
+
+    def test_in_batch_duplicate_stores_once(self, swarm):
+        """The same (key, ehash, vid) entry appearing TWICE in one
+        batch must store once (the host's same-value refresh) — the
+        store-side dup check alone cannot see an earlier row of the
+        same pass."""
+        ix = DeviceIndex(swarm, CFG, empty_store(CFG.n_nodes, SCFG),
+                         SCFG, SPEC, seed=5)
+        h = InfoHash.get("dup")
+        keys = [{"id": b"aa"}, {"id": b"aa"}]
+        ix.insert_batch(keys, _entry_rows([h, h]),
+                        np.asarray([7, 7], np.uint32))
+        assert ix.stats["entries_inserted"] == 1
+        assert ix.stats["dup_refreshed"] == 1
+        _depth, ents = ix.lookup_batch(keys[:1])
+        assert ents[0] == [(bytes(h), 7)]
+
+    def test_dup_insert_refreshes(self, swarm, built):
+        ix, orc, keys, ehash, evid = built
+        before, _ = ix.trie_snapshot()
+        ix.insert_batch(keys[:8], _entry_rows(ehash[:8]),
+                        np.asarray(evid[:8], np.uint32))
+        assert ix.stats["dup_refreshed"] >= 8
+        after, _ = ix.trie_snapshot()
+        assert before == after
+
+    def test_store_validation(self, swarm):
+        with pytest.raises(ValueError, match="slots"):
+            DeviceIndex(swarm, CFG, empty_store(CFG.n_nodes, SCFG),
+                        SCFG._replace(slots=8), SPEC)
+        with pytest.raises(ValueError, match="payload_words"):
+            DeviceIndex(swarm, CFG, empty_store(CFG.n_nodes, SCFG),
+                        SCFG._replace(payload_words=4), SPEC)
+
+
+# --------------------------------------------------------------------------
+# host ↔ device conformance (the subsystem's seed-identity pin)
+# --------------------------------------------------------------------------
+
+class TestHostDeviceConformance:
+    def test_host_pht_builds_identical_trie(self, swarm, built):
+        """The UNMODIFIED host Pht, run over the device store through
+        the StoreDht adapter with the deterministic leaf-insert rule,
+        produces the same leaves and entry sets as the device engine
+        and the oracle."""
+        ix, orc, keys, ehash, evid = built
+        adapter = StoreDht(swarm, CFG, empty_store(CFG.n_nodes, SCFG),
+                           SCFG, SPEC, seed=7)
+        hp = Pht("conf", {"id": 4}, adapter, rng=random.Random(17),
+                 parent_insert=False)
+        done = []
+        for i, k in enumerate(keys):
+            hp.insert(k, (ehash[i], evid[i]),
+                      lambda ok: done.append(ok))
+        assert len(done) == len(keys) and all(done)
+
+        reader = DeviceIndex(swarm, CFG, adapter.store, SCFG, SPEC,
+                             seed=9)
+        host_leaves, _ = reader.trie_snapshot()
+        orc_leaves = orc.leaves()
+        assert set(host_leaves) == set(orc_leaves)
+        for k in host_leaves:
+            assert host_leaves[k] == orc_leaves[k], k
+        # ... and therefore identical to the device-built trie.
+        dev_leaves, _ = ix.trie_snapshot()
+        assert host_leaves == dev_leaves
+
+    def test_host_pht_reads_device_built_trie(self, swarm, built):
+        """Host Pht lookups over the DEVICE-built store find the
+        device-inserted entries — the read direction of
+        interchangeability."""
+        ix, _orc, keys, ehash, evid = built
+        adapter = StoreDht.over(ix)
+        hp = Pht("conf", {"id": 4}, adapter, rng=random.Random(23),
+                 parent_insert=False)
+        for i in (0, 5, 13):
+            found = {}
+            hp.lookup(keys[i],
+                      lambda vals, p: found.update(vals=vals),
+                      lambda ok: found.update(done=ok))
+            assert found.get("done"), keys[i]
+            assert (ehash[i], evid[i]) in found.get("vals", []), i
+
+
+# --------------------------------------------------------------------------
+# artifact gate (tools/check_trace.py check_index_obj + check_bench)
+# --------------------------------------------------------------------------
+
+def _valid_index_artifact():
+    return {
+        "kind": "swarm_index_trace",
+        "bench": {"metric": "swarm_index_scan_entries_per_sec",
+                  "value": 1000.0, "scan_recall": 1.0,
+                  "scan_exact": True, "overfull_drops": 0,
+                  "platform": "cpu"},
+        "index": {
+            "prefix_bits": 40,
+            "probe_round_bound": 14,
+            "walk_rounds_max": 6,
+            "entries_distinct": 20,
+            "entries_in_leaves": 20,
+            "overfull_drops": 0,
+            "n_leaves": 4,
+            "n_interior": 3,
+            "splits": 1,
+            "split_levels": 3,
+            "leaf_occupancy_max": 9,
+            "leaf_occupancy_hist":
+                [1, 0, 0, 1, 0, 0, 0, 0, 1, 1, 0, 0, 0, 0, 0, 0, 0],
+            "oracle_leaf_occupancy_hist":
+                [1, 0, 0, 1, 0, 0, 0, 0, 1, 1, 0, 0, 0, 0, 0, 0, 0],
+            "oracle_agrees": True,
+            "scans": {"n": 4, "span_ranks": 8, "recall": 1.0,
+                      "exact": True, "entries_expected": 12,
+                      "entries_returned": 12, "extras": 0,
+                      "leaves_touched_mean": 2.0,
+                      "probe_batches": 8, "probe_keys": 64},
+        },
+    }
+
+
+class TestCheckIndexObj:
+    def _errs(self, obj):
+        from opendht_tpu.tools.check_trace import check_index_obj
+        return check_index_obj(obj)
+
+    def test_valid_passes(self):
+        assert self._errs(_valid_index_artifact()) == []
+
+    def test_leaf_over_capacity_fails(self):
+        o = _valid_index_artifact()
+        o["index"]["leaf_occupancy_max"] = 17
+        assert any("outside [0, 16]" in e for e in self._errs(o))
+
+    def test_split_conservation_fails(self):
+        o = _valid_index_artifact()
+        o["index"]["split_levels"] = 2
+        assert any("split accounting" in e for e in self._errs(o))
+
+    def test_entry_leak_fails(self):
+        o = _valid_index_artifact()
+        o["index"]["entries_distinct"] = 21
+        assert any("leaked" in e for e in self._errs(o))
+
+    def test_imperfect_recall_fails(self):
+        o = _valid_index_artifact()
+        o["index"]["scans"]["recall"] = 0.99
+        o["bench"]["scan_recall"] = 0.99
+        assert any("recall" in e for e in self._errs(o))
+
+    def test_extras_fail(self):
+        o = _valid_index_artifact()
+        o["index"]["scans"]["extras"] = 1
+        o["index"]["scans"]["exact"] = False
+        assert any("extras" in e or "exact" in e
+                   for e in self._errs(o))
+
+    def test_fabricated_bound_fails(self):
+        o = _valid_index_artifact()
+        o["index"]["probe_round_bound"] = 99   # not the derived bound
+        assert any("derived" in e for e in self._errs(o))
+
+    def test_rounds_over_bound_fail(self):
+        o = _valid_index_artifact()
+        o["index"]["walk_rounds_max"] = 15
+        assert any("binary-search bound" in e for e in self._errs(o))
+
+    def test_oracle_divergence_fails(self):
+        o = _valid_index_artifact()
+        o["index"]["oracle_agrees"] = False
+        assert any("oracle" in e for e in self._errs(o))
+
+
+class TestCheckBenchIndexRow:
+    def test_index_row_gates(self):
+        from opendht_tpu.tools.check_bench import check_bench_rows
+        base = _valid_index_artifact()["bench"]
+        good = dict(base, value=990.0)
+        assert check_bench_rows(good, base) == []
+        slow = dict(base, value=900.0)
+        assert any("below" in e for e in check_bench_rows(slow, base))
+        inexact = dict(base, scan_recall=0.999)
+        assert any("scan_recall" in e
+                   for e in check_bench_rows(inexact, base))
+        sloppy = dict(base, scan_exact=False)
+        assert any("scan_exact" in e
+                   for e in check_bench_rows(sloppy, base))
+        droppy = dict(base, overfull_drops=3)
+        assert any("overfull_drops" in e
+                   for e in check_bench_rows(droppy, base))

@@ -307,6 +307,9 @@ class TestRepubProfileAndStoreTraceMerge:
     def test_republish_phase_stats(self, churned, stored):
         from opendht_tpu.models.storage import republish_from
         scfg, store, _, _ = stored
+        # The insert path DONATES the store — hand it a copy so the
+        # class-scoped fixture survives for the next test.
+        store = jax.tree_util.tree_map(jnp.array, store)
         all_idx = jnp.arange(CFG.n_nodes, dtype=jnp.int32)
         stats = {"time_phases": True}
         _, rep = republish_from(churned, CFG, store, scfg, all_idx, 1,
@@ -333,7 +336,7 @@ class TestRepubProfileAndStoreTraceMerge:
         half = n // 2
         idx = jnp.arange(n, dtype=jnp.int32)
         traces = []
-        st = store
+        st = jax.tree_util.tree_map(jnp.array, store)  # donated below
         for i, chunk in enumerate((idx[:half], idx[half:])):
             st, rep = republish_from(churned, CFG, st, scfg, chunk,
                                      2 + i, jax.random.PRNGKey(20 + i))

@@ -6,7 +6,8 @@ import random
 import pytest
 
 from opendht_tpu.indexation.pht import (
-    MAX_NODE_ENTRY_COUNT, Pht, Prefix,
+    CACHE_MAX_ELEMENT, CACHE_NODE_EXPIRE_TIME, MAX_NODE_ENTRY_COUNT,
+    Cache, Pht, Prefix,
 )
 from opendht_tpu.utils.infohash import InfoHash
 
@@ -120,3 +121,220 @@ def test_invalid_key_raises(cluster):
         pht.linearize({"wrong": b"x"})
     with pytest.raises(ValueError):
         pht.linearize({"id": b"way-too-long-for-spec"})
+
+
+# --------------------------------------------------------------------------
+# Cache hardening (ref: pht.cpp:42-126)
+# --------------------------------------------------------------------------
+
+def _rand_prefix(rng, nbytes=32):
+    return Prefix(bytes(rng.getrandbits(8) for _ in range(nbytes)),
+                  nbytes * 8)
+
+
+def test_cache_expiry_hides_stale_paths():
+    clock = [0.0]
+    cache = Cache(now=lambda: clock[0])
+    p = _rand_prefix(random.Random(1))
+    cache.insert(p)
+    assert cache.lookup(p) == p.size
+    # One tick short of expiry the path is still served...
+    clock[0] = CACHE_NODE_EXPIRE_TIME
+    assert cache.lookup(p) == p.size
+    # ...one past it, nothing is (the root itself is stale: -1).
+    clock[0] = CACHE_NODE_EXPIRE_TIME + 1
+    assert cache.lookup(p) == -1
+
+
+def test_cache_eviction_at_max_element():
+    clock = [0.0]
+    cache = Cache(now=lambda: clock[0])
+    rng = random.Random(2)
+    # Fill past CACHE_MAX_ELEMENT with old paths (256 nodes each —
+    # distinct first bytes keep the subtrees disjoint).
+    old = []
+    while cache._count <= CACHE_MAX_ELEMENT:
+        p = _rand_prefix(rng)
+        old.append(p)
+        cache.insert(p)
+    over = cache._count
+    assert over > CACHE_MAX_ELEMENT
+    # A fresh insert AFTER the old paths went stale triggers the
+    # eviction sweep: stale subtrees are pruned, the fresh path stays.
+    clock[0] = CACHE_NODE_EXPIRE_TIME + 1
+    fresh = _rand_prefix(rng)
+    cache.insert(fresh)
+    assert cache._count < over
+    assert cache._count <= fresh.size + 1
+    assert cache.lookup(fresh) == fresh.size
+    # Stale paths are pruned: an old prefix resolves no deeper than
+    # its shared bits with the one fresh path (+ the refreshed root).
+    assert all(cache.lookup(p) <= Prefix.common_bits(p, fresh) + 1
+               for p in old)
+
+
+def test_cache_insert_refreshes_subpath():
+    clock = [0.0]
+    cache = Cache(now=lambda: clock[0])
+    p = _rand_prefix(random.Random(3))
+    cache.insert(p)
+    # Re-inserting a 64-bit prefix of the path later refreshes ONLY
+    # that subpath — the deeper tail keeps its old timestamp and
+    # expires alone.
+    clock[0] = CACHE_NODE_EXPIRE_TIME - 1
+    cache.insert(p.get_prefix(64))
+    clock[0] = CACHE_NODE_EXPIRE_TIME + 1
+    assert cache.lookup(p) == 64
+
+
+# --------------------------------------------------------------------------
+# z-curve property: common_bits monotone in key distance
+# --------------------------------------------------------------------------
+
+def _spec_pht(key_spec):
+    class _NoDht:
+        pass
+    return Pht("zprop", key_spec, _NoDht(), rng=random.Random(5))
+
+
+def test_zcurve_common_bits_identity():
+    """The z-curve interleave maps per-field divergence points to ONE
+    combined divergence: common_bits(z(a), z(b)) ==
+    min over fields f of (per-field common bits · n_fields + f) —
+    the exact identity the device kernel's bit-transpose mirrors
+    (``_linearize_batch``, models/index.py)."""
+    pht = _spec_pht({"a": 4, "b": 4})
+    # A single-field Pht with the same max field width linearizes to
+    # exactly the padded+terminated per-field prefix (zcurve of one
+    # field is the identity).
+    pf = _spec_pht({"x": 4})
+    names = sorted(pht.key_spec)
+    nf = len(names)
+    rng = random.Random(7)
+    for _ in range(50):
+        ka = {n: bytes(rng.getrandbits(8)
+                       for _ in range(rng.randint(0, 4)))
+              for n in names}
+        kb = {n: bytes(rng.getrandbits(8)
+                       for _ in range(rng.randint(0, 4)))
+              for n in names}
+        za, zb = pht.linearize(ka), pht.linearize(kb)
+        per_field = []
+        for f, n in enumerate(names):
+            cbf = Prefix.common_bits(pf.linearize({"x": ka[n]}),
+                                     pf.linearize({"x": kb[n]}))
+            per_field.append(cbf * nf + f)
+        want = min(per_field)
+        got = Prefix.common_bits(za, zb)
+        assert got == want, (ka, kb, got, want)
+
+
+def test_zcurve_monotone_in_shared_prefix():
+    """Longer shared byte prefixes never DECREASE the z-curve
+    common-bits — the ordering property range scans rely on."""
+    pht = _spec_pht({"id": 8})
+    rng = random.Random(11)
+    for _ in range(20):
+        base = bytes(rng.getrandbits(8) for _ in range(6))
+        x = {"id": base + b"aa"}
+        prev = -1
+        for share in range(7):
+            y = {"id": base[:share]
+                 + bytes((b + 1) % 256 for b in base[share:])
+                 + b"aa"}
+            cb = Prefix.common_bits(pht.linearize(x), pht.linearize(y))
+            assert cb >= prev, (share, cb, prev)
+            assert cb >= share * 8
+            prev = cb
+        full = Prefix.common_bits(pht.linearize(x), pht.linearize(x))
+        assert full == pht.linearize(x).size
+        assert full >= prev
+
+
+# --------------------------------------------------------------------------
+# split-then-lookup at exactly MAX_NODE_ENTRY_COUNT + 1 entries
+# --------------------------------------------------------------------------
+
+class _MemDht:
+    """Synchronous in-memory DHT (get/put/listen), value-deduplicated
+    like real storage — isolates the Pht trie logic from network
+    pacing so the split regression runs in milliseconds."""
+
+    def __init__(self):
+        self.store = {}
+        self.listeners = {}
+
+    def get(self, h, get_cb, done_cb=None, f=None):
+        vals = list(self.store.get(bytes(h), []))
+        if f is not None:
+            vals = [v for v in vals if f(v)]
+        if vals and get_cb is not None:
+            get_cb(vals)
+        if done_cb:
+            done_cb(True, None)
+
+    def put(self, h, value, done_cb=None):
+        vals = self.store.setdefault(bytes(h), [])
+        if not any(v.user_type == value.user_type
+                   and v.data == value.data for v in vals):
+            vals.append(value)
+        if done_cb:
+            done_cb(True, None)
+        for cb, f in list(self.listeners.get(bytes(h), ())):
+            vs = [v for v in vals if f is None or f(v)]
+            if vs:
+                cb(vs)
+
+    def listen(self, h, cb, f=None):
+        self.listeners.setdefault(bytes(h), []).append((cb, f))
+        vs = [v for v in self.store.get(bytes(h), ())
+              if f is None or f(v)]
+        if vs:
+            cb(vs)
+        return len(self.listeners[bytes(h)])
+
+
+@pytest.mark.parametrize("parent_insert", [True, False])
+def test_split_at_capacity_plus_one_keeps_all_entries(parent_insert):
+    """The (MAX_NODE_ENTRY_COUNT+1)-th entry at a shared-prefix leaf
+    forces a split cycle; every entry (migrated and new) must remain
+    reachable by exact lookup afterwards (ref: Pht::split
+    pht.cpp:503-514) — under both the reference's parent-insert
+    heuristic and the deterministic leaf rule."""
+    dht = _MemDht()
+    pht = Pht("split17", {"id": 8}, dht, rng=random.Random(19),
+              parent_insert=parent_insert)
+    n = MAX_NODE_ENTRY_COUNT + 1
+    keys = [b"pfx" + bytes([i]) for i in range(n)]
+    done = []
+    for i, k in enumerate(keys):
+        pht.insert({"id": k}, (InfoHash.get(k.decode("latin1")), i),
+                   lambda ok: done.append(ok))
+    assert len(done) == n and all(done)
+
+    # The trie actually split: some canary exists below the root.
+    deep = [h for h, vs in dht.store.items()
+            if any(v.user_type == pht.canary for v in vs)]
+    assert len(deep) > 1
+
+    found = {}
+    for i, k in enumerate(keys):
+        res = {}
+        pht.lookup({"id": k},
+                   lambda vals, p, res=res: res.update(vals=vals),
+                   lambda ok, res=res: res.update(done=ok))
+        assert res.get("done"), k
+        if (InfoHash.get(k.decode("latin1")), i) in res.get("vals", []):
+            found[k] = True
+    assert len(found) == n, (len(found), n)
+
+    # A SECOND Pht instance (fresh cache) sees the same entries.
+    pht2 = Pht("split17", {"id": 8}, dht, rng=random.Random(29),
+               parent_insert=parent_insert)
+    res = {}
+    pht2.lookup({"id": keys[0]},
+                lambda vals, p: res.update(vals=vals),
+                lambda ok: res.update(done=ok))
+    assert res.get("done")
+    assert (InfoHash.get(keys[0].decode("latin1")), 0) \
+        in res.get("vals", [])
