@@ -84,6 +84,20 @@ bench:
 # (coverage floor + lag bound vs the recorded MONITOR_GATE_r08.json);
 # the checked-in 1M acceptance artifact MONITOR_r08.json is
 # re-validated so the committed record can never rot.
+# The SOAK leg (round 15): the always-on node — serve + republish +
+# monitor + listener maintenance in ONE slot plane, churn every second
+# plus a contiguous keyspace outage mid-run, and the maintenance-off
+# A/B arm on the same schedule.  check_trace proves the artifact's
+# conservation planes (per-interval serve+maintenance slot-rounds ==
+# total dispatched, lifecycle conservation per work class at every
+# interval boundary, device work-class plane == host bookkeeping,
+# monitor freshness identities + lag bound, value survival above the
+# scenario-derived floor, interference ledger reproducible from the
+# embedded timelines); check_bench floors the rate (0.90x — the open
+# loop's scenario response is noisier than a closed bench; quality
+# gates are absolute) and ceilings p99 at 2.0x vs the recorded
+# BENCH_GATE_r11.json.  The committed 1M/60s acceptance artifact
+# SOAK_r11.json is re-validated so the record can never rot.
 # The INDEX leg (round 14): a small device-PHT build + Zipf range
 # scans through the batched trie engine; check_trace proves the
 # artifact's structural invariants (leaf occupancy <= 16, split
@@ -124,6 +138,10 @@ gate: lint test
 #   runs.  The exactness gates (recall == 1.0, zero extras, leaf/split
 #   conservation) are absolute and unaffected by the looser floor.
 	python -m opendht_tpu.tools.check_trace INDEX_r10.json
+	python bench.py --mode soak --nodes 16384 --arrival-rate 1500 --duration 5 --serve-slots 1024 --key-pool 1024 --puts 1024 --outage-frac 0.02 --slo-ms 500 --soak-out /tmp/soak.json
+	python -m opendht_tpu.tools.check_trace /tmp/soak.json
+	python -m opendht_tpu.tools.check_bench /tmp/soak.json BENCH_GATE_r11.json --min-ratio 0.90 --max-p99-ratio 2.0
+	python -m opendht_tpu.tools.check_trace SOAK_r11.json
 	python bench.py --mode chaos --nodes 16384 --puts 2048
 	python bench.py --mode chaos-lookup --nodes 16384 --lookups 4096 --recall-sample 256
 

@@ -113,7 +113,7 @@ def main():
                     choices=("lookups", "putget", "churn", "crawl",
                              "sharded", "hotshard", "repub", "chaos",
                              "chaos-lookup", "repub-profile", "serve",
-                             "monitor", "index"),
+                             "monitor", "index", "soak"),
                     default="lookups")
     ap.add_argument("--kill-frac", type=float, default=None,
                     help="fraction of nodes killed (churn/chaos: 0.5; "
@@ -263,6 +263,73 @@ def main():
                          "Poisson density profile) as JSON — "
                          "validated by tools/check_trace.py, gated by "
                          "tools/check_bench.py")
+    ap.add_argument("--mix", choices=("read-heavy", "write-heavy",
+                                      "scan-heavy"),
+                    default="read-heavy",
+                    help="soak mode: scenario mix preset — the "
+                         "write/scan fractions of the arrival stream "
+                         "(read-heavy: 5%% writes; write-heavy: 50%% "
+                         "writes; scan-heavy: 5%% writes + 20%% index "
+                         "range scans); --write-frac/--scan-frac "
+                         "override the preset")
+    ap.add_argument("--write-frac", type=float, default=None,
+                    help="soak mode: fraction of arrivals that are "
+                         "writes (announce with bumped seq), "
+                         "overriding --mix; must be in [0, 1] with "
+                         "write + scan <= 1")
+    ap.add_argument("--scan-frac", type=float, default=None,
+                    help="soak mode: fraction of arrivals that are "
+                         "index range scans (the PR-10 trie engine in "
+                         "the same arrival stream), overriding --mix")
+    ap.add_argument("--soak-interval", type=float, default=0.5,
+                    help="soak mode: timeline interval width in "
+                         "seconds (both A/B arms use it — the unit "
+                         "of every conservation row and interference "
+                         "attribution)")
+    ap.add_argument("--repub-period", type=float, default=1.0,
+                    help="soak mode: seconds between the end of one "
+                         "republish sweep and the begin of the next")
+    ap.add_argument("--monitor-gap", type=float, default=0.0,
+                    help="soak mode: seconds between monitor sweeps "
+                         "(0 = continuous crawling)")
+    ap.add_argument("--maint-cap", type=int, default=256,
+                    help="soak mode: maintenance rows admitted into "
+                         "free slots per loop iteration at most")
+    ap.add_argument("--maint-slot-frac", type=float, default=0.25,
+                    help="soak mode: hard ceiling on the fraction of "
+                         "serve slots maintenance may occupy at once "
+                         "(the admission reserve keeping a crawl from "
+                         "crowding the slot plane)")
+    ap.add_argument("--monitor-bootstrap", action="store_true",
+                    help="soak mode: run the monitor's initial full "
+                         "crawl CLOSED-LOOP at setup (a joining "
+                         "node's bootstrap crawl, the PR-8 path) so "
+                         "the interleaved sweeps are the steady-state "
+                         "incremental ones — the 1M acceptance shape, "
+                         "where a full grid sweep through the slot "
+                         "plane outlasts the serve horizon")
+    ap.add_argument("--churn-every", type=float, default=1.0,
+                    help="soak mode: seconds between churn events "
+                         "(each kills --kill-frac of live nodes, "
+                         "then heals routing tables); 0 disables")
+    ap.add_argument("--slo-violation-max", type=float, default=0.10,
+                    help="soak mode: the SLO violation-ratio bound "
+                         "the artifact states and check_trace gates "
+                         "the measured ratio against")
+    ap.add_argument("--interference", choices=("on", "off"),
+                    default="on",
+                    help="soak mode: run the maintenance-off A/B arm "
+                         "on the same arrival schedule and emit the "
+                         "interference ledger (off = single arm, no "
+                         "ledger — smoke runs only)")
+    ap.add_argument("--soak-out", metavar="FILE", default=None,
+                    help="soak mode: dump the swarm_soak_trace "
+                         "artifact (per-interval timeline, lifecycle "
+                         "conservation per work class, interference "
+                         "ledger, monitor + republish blocks, SLO "
+                         "gauges) as JSON — validated by "
+                         "tools/check_trace.py, gated by "
+                         "tools/check_bench.py")
     args = ap.parse_args()
 
     # Fault fractions are probabilities: reject out-of-range values
@@ -281,7 +348,10 @@ def main():
         # 0.05; the monitor watches an honest swarm unless asked.
         args.byzantine_frac = 0.05 if args.mode == "chaos-lookup" \
             else 0.0
-    if args.mode == "monitor":
+    if args.mode in ("monitor", "soak"):
+        # Soak consumes the monitor knobs too (its interleaved sweeps
+        # are MonitorEngine sweeps): invalid values must fail at this
+        # boundary, not deep inside the engine.
         if args.sweeps < 1:
             ap.error(f"--sweeps must be >= 1, got {args.sweeps}")
         if args.monitor_period < 1:
@@ -296,11 +366,13 @@ def main():
             ap.error(f"--stale-threshold must be a fraction in [0, 1],"
                      f" got {args.stale_threshold}")
 
-    if args.mode == "serve":
-        # Serve-arg validation at the CLI boundary (the satellite
+    if args.mode in ("serve", "soak"):
+        # Serve/soak-arg validation at the CLI boundary (the satellite
         # contract): rates/durations are physical quantities — a ≤0
         # value or an uncapped duration must fail HERE, loudly, not as
-        # a shape crash or a gate-timeout three layers down.
+        # a shape crash or a gate-timeout three layers down.  Soak
+        # reuses the serve path verbatim: its open loop IS the serve
+        # loop plus maintenance.
         if args.arrival_rate <= 0:
             ap.error(f"--arrival-rate must be > 0 req/s, got "
                      f"{args.arrival_rate}")
@@ -308,9 +380,9 @@ def main():
             ap.error(f"--duration must be > 0 s, got {args.duration}")
         if args.duration > 120:
             ap.error(f"--duration {args.duration}s exceeds the 120 s "
-                     f"serve cap (the tier-1 gate runs under a 870 s "
-                     f"timeout; a longer open-loop run cannot fit a "
-                     f"gate leg — split it into repeats)")
+                     f"{args.mode} cap (the tier-1 gate runs under a "
+                     f"870 s timeout; a longer open-loop run cannot "
+                     f"fit a gate leg — split it into repeats)")
         if args.serve_slots < 8:
             ap.error(f"--serve-slots must be >= 8, got "
                      f"{args.serve_slots}")
@@ -323,12 +395,47 @@ def main():
             ap.error(f"--slo-ms must be > 0, got {args.slo_ms}")
         if args.zipf is not None and args.zipf < 0:
             ap.error(f"--zipf must be >= 0, got {args.zipf}")
+    if args.mode == "soak":
+        # Scenario-mix fractions are probabilities over the arrival
+        # stream: presets resolve first, explicit flags override, and
+        # anything outside [0, 1] (or a mix that sums past 1) fails
+        # HERE instead of as a nonsense schedule in the artifact.
+        preset = {"read-heavy": (0.05, 0.0),
+                  "write-heavy": (0.50, 0.0),
+                  "scan-heavy": (0.05, 0.20)}[args.mix]
+        if args.write_frac is None:
+            args.write_frac = preset[0]
+        if args.scan_frac is None:
+            args.scan_frac = preset[1]
+        for nm in ("write_frac", "scan_frac"):
+            v = getattr(args, nm)
+            if not 0.0 <= v <= 1.0:
+                ap.error(f"--{nm.replace('_', '-')} must be a "
+                         f"fraction in [0, 1], got {v}")
+        if args.write_frac + args.scan_frac > 1.0:
+            ap.error(f"scenario mix over-full: write {args.write_frac}"
+                     f" + scan {args.scan_frac} > 1")
+        if args.soak_interval <= 0:
+            ap.error(f"--soak-interval must be > 0 s, got "
+                     f"{args.soak_interval}")
+        if args.repub_period < 0 or args.monitor_gap < 0 \
+                or args.churn_every < 0:
+            ap.error("--repub-period/--monitor-gap/--churn-every "
+                     "must be >= 0")
+        if args.maint_cap < 1:
+            ap.error(f"--maint-cap must be >= 1, got {args.maint_cap}")
+        if not 0.0 < args.maint_slot_frac <= 1.0:
+            ap.error(f"--maint-slot-frac must be in (0, 1], got "
+                     f"{args.maint_slot_frac}")
+        if not 0.0 < args.slo_violation_max <= 1.0:
+            ap.error(f"--slo-violation-max must be in (0, 1], got "
+                     f"{args.slo_violation_max}")
     if args.zipf is None and args.mode == "index":
         # Read-heavy scans over a skewed index (arXiv:1009.3681's
         # workload shape): hot keys hold multiple entries, hot ranges
         # get scanned more.
         args.zipf = 1.2
-    if args.zipf is None and args.mode != "serve":
+    if args.zipf is None and args.mode not in ("serve", "soak"):
         # Non-serve modes keep their historical default (uniform for
         # churn, the 1.2 hotshard fallback keys off 0).
         args.zipf = 0.0
@@ -343,7 +450,8 @@ def main():
             ap.error(f"--key-pool must be >= 2, got {args.key_pool}")
     if args.kill_frac is None:
         args.kill_frac = {"chaos-lookup": 0.10,
-                          "monitor": 0.05}.get(args.mode, 0.5)
+                          "monitor": 0.05,
+                          "soak": 0.02}.get(args.mode, 0.5)
     if args.nodes is None:
         args.nodes = {"churn": 100_000, "sharded": 1_000_000,
                       "hotshard": 1_000_000,
@@ -351,6 +459,7 @@ def main():
                       "chaos": 65_536,
                       "repub-profile": 65_536,
                       "serve": 65_536,
+                      "soak": 65_536,
                       "monitor": 1_000_000,
                       "index": 1_000_000,
                       "chaos-lookup": 1_000_000}.get(args.mode,
@@ -362,6 +471,8 @@ def main():
         # clocks produce.
         ap.error("--ledger-out requires the compacted dispatcher in "
                  "lookups mode (drop --compact off)")
+    if args.mode == "soak":
+        return soak_main(args)
     if args.mode == "monitor":
         return monitor_main(args)
     if args.mode == "index":
@@ -1763,7 +1874,8 @@ def monitor_main(args):
     from opendht_tpu.models.swarm import (
         LookupFaults, SwarmConfig, build_swarm, corrupt_swarm,
     )
-    from opendht_tpu.obs.health import hop_fidelity, SwarmHealthPlane
+    from opendht_tpu.obs.health import (hop_fidelity, SwarmHealthPlane,
+                                    summarize_sweeps)
     from opendht_tpu.utils.metrics import MetricsRegistry
 
     kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
@@ -1805,21 +1917,20 @@ def monitor_main(args):
         plane.publish_sweep(rec)
 
     recs = engine.records
-    post = recs[1:] or recs      # steady state = post-initial sweeps
-    lag_cnt = sum(r["lag_count"] for r in recs)
-    lag_max = max((r["lag_max"] for r in recs if r["lag_count"]),
-                  default=None)
+    # ONE sweep-record reduction, shared with the soak bench and the
+    # soak checker's recomputation (obs.health.summarize_sweeps) — a
+    # second inline copy here would let the two modes' "same" summary
+    # fields drift apart.
+    summary = summarize_sweeps(recs)
     fidelity = hop_fidelity(engine.hop_hist_initial,
                             engine.initial_alive,
                             bucket_k=cfg.bucket_k, alpha=cfg.alpha,
                             quorum=cfg.quorum)
     density = plane.publish_density(engine.bucket_counts[0])
     walls = [r["wall_s"] for r in recs]
-    final = recs[-1]
     out = {
         "metric": "swarm_monitor_coverage",
-        "value": round(float(np.mean([r["coverage"] for r in post])),
-                       6),
+        "value": summary["coverage_mean"],
         "unit": "fraction",
         # No host-path continuous monitor exists to divide by; the
         # one-shot crawl row (BENCH_GATE_r08.json) is the static
@@ -1840,20 +1951,18 @@ def monitor_main(args):
         "miss_limit": mcfg.miss_limit,
         "stale_threshold": mcfg.stale_threshold,
         "detection_lag_bound_sweeps": mcfg.detection_lag_bound,
-        "coverage_min": round(min(r["coverage"] for r in post), 6),
-        "coverage_final": final["coverage"],
-        "detection_lag_mean": (round(
-            sum(r["lag_sum"] for r in recs) / lag_cnt, 3)
-            if lag_cnt else None),
-        "detection_lag_max": lag_max,
-        "deaths_detected": lag_cnt,
-        "false_dead_final": final["false_dead"],
-        "false_alive_final": final["false_alive"],
-        "freshness_p50_final": final["age_p50"],
-        "freshness_p99_final": final["age_p99"],
+        "coverage_min": summary["coverage_min"],
+        "coverage_final": summary["coverage_final"],
+        "detection_lag_mean": summary["detection_lag_mean"],
+        "detection_lag_max": summary["detection_lag_max"],
+        "deaths_detected": summary["deaths_detected"],
+        "false_dead_final": summary["false_dead_final"],
+        "false_alive_final": summary["false_alive_final"],
+        "freshness_p50_final": summary["freshness_p50_final"],
+        "freshness_p99_final": summary["freshness_p99_final"],
         "buckets_probed_mean": round(
             float(np.mean([r["buckets_probed"] for r in recs])), 1),
-        "lookups_total": sum(r["lookups"] for r in recs),
+        "lookups_total": summary["lookups_total"],
         "done_frac": round(
             float(np.mean([r["done_frac"] for r in recs])), 6),
         "sweep_wall_p50": round(float(np.percentile(walls, 50)), 4),
@@ -2114,6 +2223,342 @@ def index_main(args):
               f"{want_total}, {extras} extras", file=sys.stderr)
         return 1
     return 0
+
+
+def soak_main(args):
+    """Always-on node soak: serve + maintenance + monitor in ONE
+    engine (ROADMAP #2, the reference's scheduler loop,
+    include/opendht/scheduler.h:38-123).
+
+    Setup announces ``--puts`` tracked values (the survival set) and
+    registers listeners, then drives a Poisson/Zipf arrival stream
+    (``--mix`` read/write/scan fractions) through the slot-recycled
+    soak engine while republish sweeps, monitor sweeps and listener
+    refreshes interleave as micro-batches into FREE serve slots —
+    churn every ``--churn-every`` seconds and one contiguous
+    ``--outage-frac`` keyspace outage at mid-run, all DURING serving.
+    With ``--interference on`` (default) the SAME schedule then runs a
+    maintenance-OFF arm (writes, scans and faults still on — only
+    republish/monitor/listener work withheld) and the interference
+    ledger attributes the serve-p99 delta to maintenance bursts: the
+    measured cost of interleaving the 5.73 s standalone sweep.
+
+    The artifact (``--soak-out``, kind ``swarm_soak_trace``) carries
+    the per-interval timeline (slot-round splits, latency histograms,
+    lifecycle boundary snapshots), the monitor block (freshness
+    conservation + detection lag vs the scheduler bound), the
+    republish block (sweep records + value survival on the tracked
+    keyset), the SLO gauges, and the interference ledger —
+    ``tools/check_trace.py check_soak_obj`` re-derives and gates all
+    of it; ``tools/check_bench.py`` floors the rate/p99/coverage/
+    survival against the recorded register row.  Overload exits 2
+    with the lower-rate-or-raise-slots message.
+    """
+    import struct
+
+    from opendht_tpu.models.monitor import MonitorConfig, MonitorEngine
+    from opendht_tpu.models.serve import ServeOverloadError
+    from opendht_tpu.models.soak import (
+        ScenarioEvent, SoakConfig, SoakEngine, mixed_events,
+        soak_open_loop,
+    )
+    from opendht_tpu.models.storage import (
+        StoreConfig, announce, empty_store, get_values, listen_at,
+    )
+    from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+    from opendht_tpu.obs.health import summarize_sweeps
+    from opendht_tpu.obs.latency import LatencyPlane
+    from opendht_tpu.obs.timeline import (
+        SoakPlane, SoakTimeline, interference_ledger,
+    )
+    from opendht_tpu.utils.metrics import Histogram, MetricsRegistry
+
+    kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    kw["merge_impl"] = args.merge_impl
+    cfg = SwarmConfig.for_nodes(args.nodes, **kw)
+    store_slots = args.slots or 4
+    scfg = StoreConfig(slots=store_slots, listen_slots=4,
+                       max_listeners=1 << 10, payload_words=0)
+    p = min(args.puts, args.nodes * store_slots // 16)
+    put_keys = jax.random.bits(jax.random.PRNGKey(11), (p, 5),
+                               jnp.uint32)
+    zipf_s = 1.1 if args.zipf is None else args.zipf
+    ts, keys, klass, ops, scan_lo, scan_hi = mixed_events(
+        rate=args.arrival_rate, duration=args.duration,
+        key_pool=args.key_pool, zipf_s=zipf_s, seed=7,
+        write_frac=args.write_frac, scan_frac=args.scan_frac,
+        scan_span=args.scan_span)
+    mcfg = MonitorConfig.for_nodes(
+        args.nodes, period=args.monitor_period,
+        fresh_ttl=args.fresh_ttl,
+        stale_threshold=args.stale_threshold,
+        miss_limit=args.miss_limit)
+    soak_cfg = SoakConfig(interval_s=args.soak_interval,
+                          repub_period_s=args.repub_period,
+                          monitor_gap_s=args.monitor_gap,
+                          maint_cap=args.maint_cap,
+                          maint_slot_frac=args.maint_slot_frac)
+    scenario = []
+    if args.churn_every > 0 and args.kill_frac > 0:
+        t_ev = args.churn_every
+        while t_ev < args.duration:
+            scenario.append(ScenarioEvent(t_ev, "churn",
+                                          args.kill_frac))
+            t_ev += args.churn_every
+    if args.outage_frac > 0:
+        scenario.append(ScenarioEvent(args.duration / 2, "outage",
+                                      args.outage_frac))
+    slo_s = args.slo_ms / 1e3
+    spec = None
+    if args.scan_frac > 0:
+        from opendht_tpu.models.index import IndexSpec
+        spec = IndexSpec.from_key_spec("bench", {"k": 4})
+
+    def build_arm(with_monitor: bool):
+        """One A/B arm from identical seeds: same swarm, same initial
+        store content, same index entries — the arms differ ONLY in
+        whether maintenance runs."""
+        swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+        _ = np.asarray(swarm.tables[:1, :1])
+        store = empty_store(cfg.n_nodes, scfg)
+        store, rep0 = announce(swarm, cfg, store, scfg, put_keys,
+                               jnp.arange(p, dtype=jnp.uint32) + 1,
+                               jnp.ones((p,), jnp.uint32), 0,
+                               jax.random.PRNGKey(12))
+        nl = min(64, p)
+        store, _regs = listen_at(swarm, cfg, store, scfg,
+                                 put_keys[:nl],
+                                 jnp.arange(nl, dtype=jnp.int32),
+                                 jax.random.PRNGKey(13), 0)
+        index = scan_key_fn = None
+        if spec is not None:
+            from opendht_tpu.models.index import DeviceIndex
+            iscfg = StoreConfig(slots=24, listen_slots=1,
+                                max_listeners=64,
+                                payload_words=spec.payload_words)
+            index = DeviceIndex(swarm, cfg,
+                                empty_store(cfg.n_nodes, iscfg),
+                                iscfg, spec, seed=3)
+            n_ent = min(args.entries, 16 * args.key_pool)
+            rng = np.random.default_rng(7)
+            draws = rng.integers(0, args.key_pool, size=n_ent)
+            per_key, ranks, dups = {}, [], []
+            for r in draws:
+                cnt = per_key.get(int(r), 0)
+                if cnt >= 16:
+                    continue
+                per_key[int(r)] = cnt + 1
+                ranks.append(int(r))
+                dups.append(cnt)
+            ekeys = [{"k": struct.pack(">I", r)} for r in ranks]
+            ehash = np.stack([np.frombuffer(
+                hashlib.sha1(b"e%d.%d" % (r, d)).digest(),
+                dtype=">u4")
+                for r, d in zip(ranks, dups)]).astype(np.uint32)
+            index.insert_batch(ekeys, ehash,
+                               np.arange(len(ranks), dtype=np.uint32))
+            scan_key_fn = (lambda rank:
+                           {"k": struct.pack(">I", int(rank))})
+        mon = MonitorEngine(swarm, cfg, mcfg) if with_monitor else None
+        if mon is not None and args.monitor_bootstrap:
+            # Bootstrap crawl, closed-loop and OFF the soak clock (the
+            # node joining the swarm); the soak's interleaved sweeps
+            # then start at the steady-state incremental width.
+            mon.sweep(jax.random.PRNGKey(400))
+        soak = SoakEngine(swarm, cfg, slots=args.serve_slots,
+                          scfg=scfg, store=store, monitor=mon,
+                          index=index, scan_key_fn=scan_key_fn,
+                          soak_cfg=soak_cfg,
+                          maint_key=jax.random.PRNGKey(0x50AC))
+        return soak, rep0
+
+    def survival(soak_arm):
+        res = get_values(soak_arm.swarm, cfg, soak_arm.store, scfg,
+                         put_keys, jax.random.PRNGKey(99))
+        return round(float(np.asarray(res.hit).mean()), 6)
+
+    registry = MetricsRegistry()
+    plane = LatencyPlane(registry, prefix="dht_soak_request",
+                         label_names=("op",), slo_target_s=slo_s)
+    soak_plane = SoakPlane(registry)
+
+    def run_arm(maintenance: bool, lat_plane):
+        soak, rep0 = build_arm(with_monitor=maintenance)
+        tl = SoakTimeline(args.soak_interval, args.serve_slots,
+                          slo_target_s=slo_s)
+        try:
+            rep = soak_open_loop(
+                soak, ts, keys, jax.random.PRNGKey(3), klass=klass,
+                ops=ops, scan_lo=scan_lo, scan_hi=scan_hi,
+                burst=args.serve_burst, duration=args.duration,
+                maintenance=maintenance, scenario=tuple(scenario),
+                timeline=tl, latency_plane=lat_plane)
+        except ServeOverloadError as e:
+            print(f"bench: {e}", file=sys.stderr)
+            sys.exit(2)
+        return soak, tl, rep, rep0
+
+    soak_on, tl_on, rep, _rep0 = run_arm(True, plane)
+    survival_on = survival(soak_on)
+    mon_summary = summarize_sweeps(soak_on.mon.records) \
+        if soak_on.mon is not None and soak_on.mon.records else None
+    ledger = None
+    survival_off = None
+    tl_off = None
+    if args.interference == "on":
+        soak_off, tl_off, rep_off, _ = run_arm(False, None)
+        survival_off = survival(soak_off)
+        ledger = interference_ledger(tl_on.to_obj(), tl_off.to_obj())
+
+    for row in tl_on.rows:
+        soak_plane.publish_interval(row)
+
+    # Overall slot-served latency distribution = the timeline rows'
+    # histogram sum (scan latencies are summarized separately — see
+    # obs.timeline's class contract).
+    bounds = tl_on.bounds
+    counts = np.sum([r["latency_counts"] for r in tl_on.rows],
+                    axis=0).astype(int) if tl_on.rows \
+        else np.zeros(len(bounds) + 1, int)
+    lat_sum = float(sum(r["latency_sum_s"] for r in tl_on.rows))
+    agg = Histogram("soak_latency_agg", "", buckets=bounds)
+    agg.observe_bulk([int(v) for v in counts], lat_sum)
+    n_lat = int(counts.sum())
+    quants = {nm: (round(agg.quantile(q), 6) if n_lat else None)
+              for nm, q in (("p50", 0.50), ("p95", 0.95),
+                            ("p99", 0.99), ("p999", 0.999))}
+    slo_violations = sum(r["slo_violations"] for r in tl_on.rows)
+    slo_ratio = round(slo_violations / n_lat, 6) if n_lat else 0.0
+    offered = rep["admitted"] + rep["never_admitted"]
+    lag_max = mon_summary["detection_lag_max"] if mon_summary else None
+    cov = mon_summary["coverage_mean"] if mon_summary else None
+
+    out = {
+        "metric": "swarm_soak_req_per_sec",
+        "value": round(rep["sustained_rps"], 1),
+        "unit": "req/s",
+        "vs_baseline": round(rep["sustained_rps"] / 1600.0, 2),
+        "baseline_note": "vs the reference's 1600 req/s global "
+                         "inbound rate cap (include/opendht/"
+                         "network_engine.h:462), WITH maintenance + "
+                         "monitoring interleaved",
+        "n_nodes": args.nodes,
+        "arrival_rate": args.arrival_rate,
+        "duration_s": args.duration,
+        "elapsed_s": round(rep["elapsed_s"], 4),
+        "serve_slots": rep["slots"],
+        "burst": rep["burst"],
+        "rounds": rep["rounds"],
+        "mix": args.mix,
+        "write_frac": args.write_frac,
+        "scan_frac": args.scan_frac,
+        "kill_frac": args.kill_frac,
+        "churn_every_s": args.churn_every,
+        "outage_frac": args.outage_frac,
+        "admitted": rep["admitted"],
+        "completed": rep["completed"],
+        "expired": rep["expired"],
+        "in_flight": rep["in_flight"],
+        "done_frac": round(rep["completed"] / offered, 6)
+        if offered else 0.0,
+        "latency_p50_s": quants["p50"],
+        "latency_p95_s": quants["p95"],
+        "latency_p99_s": quants["p99"],
+        "latency_p999_s": quants["p999"],
+        "slot_occupancy_frac": round(rep["slot_occupancy_frac"], 4),
+        "wclass_mismatches": rep["wclass_mismatches"],
+        "slo_target_s": slo_s,
+        "slo_violation_ratio": slo_ratio,
+        "slo_violation_max": args.slo_violation_max,
+        "slo_error_budget_burn_rate": round(plane.burn_rate, 3),
+        "repub_sweeps": len(rep["repub_sweeps"]),
+        "monitor_sweeps": len(rep["monitor_sweeps"]),
+        "maint_ops": len(rep["maint_ops"]),
+        "monitor_coverage": cov,
+        "detection_lag_max": lag_max,
+        "detection_lag_bound_sweeps": mcfg.detection_lag_bound,
+        "deaths_detected": mon_summary["deaths_detected"]
+        if mon_summary else None,
+        "value_survival_initial": 1.0,
+        "value_survival_final": survival_on,
+        "value_survival_off_arm": survival_off,
+        "scan_completed": rep["scan"]["completed"],
+        "scan_latency_mean_s": rep["scan"]["latency_mean_s"],
+        "maint_interference_p99_delta_s": ledger["p99_delta_s"]
+        if ledger else None,
+        "maint_p99_on_s": ledger["p99_on_s"] if ledger else None,
+        "maint_p99_off_s": ledger["p99_off_s"] if ledger else None,
+        "zipf_s": zipf_s,
+        "key_pool": args.key_pool,
+        "puts": p,
+        "platform": jax.devices()[0].platform,
+    }
+    if args.soak_out:
+        obj = {
+            "kind": "swarm_soak_trace",
+            "bench": out,
+            "lifecycle": {
+                "by_class": rep["lifecycle_by_class"],
+                "admitted": rep["admitted"],
+                "completed": rep["completed"],
+                "expired": rep["expired"],
+                "in_flight": rep["in_flight"],
+                "never_admitted": rep["never_admitted"],
+                "wclass_mismatches": rep["wclass_mismatches"],
+                "scan": rep["scan"],
+            },
+            "timeline": tl_on.to_obj(),
+            "timeline_off": tl_off.to_obj()
+            if tl_off is not None else None,
+            "interference": ledger,
+            "monitor": {
+                "config": {
+                    "depth": mcfg.depth,
+                    "period": mcfg.period,
+                    "fresh_ttl": mcfg.fresh_ttl,
+                    "stale_threshold": mcfg.stale_threshold,
+                    "miss_limit": mcfg.miss_limit,
+                    "age_cap": mcfg.age_cap,
+                    "detection_lag_bound_sweeps":
+                        mcfg.detection_lag_bound,
+                    "bucket_k": cfg.bucket_k,
+                    "alpha": cfg.alpha,
+                    "quorum": cfg.quorum,
+                    "max_steps": cfg.max_steps,
+                },
+                "sweeps": soak_on.mon.records
+                if soak_on.mon is not None else [],
+                "summary": mon_summary,
+            },
+            "repub": {
+                "period_s": args.repub_period,
+                "sweeps": rep["repub_sweeps"],
+                "survival_initial": 1.0,
+                "survival_final": survival_on,
+                "survival_off_arm": survival_off,
+                # Scenario-derived floor: keys wholly inside a
+                # contiguous outage lose every replica at once and no
+                # republish can resurrect them (the checker recomputes
+                # the minimum admissible floor from outage_frac).
+                "survival_floor": round(
+                    max(0.9, 1.0 - 1.5 * args.outage_frac - 0.002),
+                    6),
+                "tracked_values": p,
+            },
+            "maint_ops": rep["maint_ops"],
+            "latency_histogram": {
+                "bounds": bounds,
+                "counts": [int(v) for v in counts],
+                "sum": round(lat_sum, 6),
+                "count": n_lat,
+            },
+            "latency_quantiles_s": quants,
+            "metrics_prometheus": registry.render_prometheus(),
+        }
+        with open(args.soak_out, "w") as f:
+            json.dump(obj, f)
+            f.write("\n")
+    print(json.dumps(out))
 
 
 def serve_main(args):

@@ -398,30 +398,48 @@ class MonitorEngine:
             return res
         return lookup(self.swarm, self.cfg, targets, key)
 
-    def sweep(self, key: jax.Array, buckets=None
-              ) -> tuple[dict, LookupResult]:
-        """Run one monitoring sweep; returns ``(record, result)``.
+    def begin_sweep(self, buckets=None
+                    ) -> tuple[np.ndarray, jax.Array]:
+        """Open sweep ``self.sweep_idx``: pick the stale-bucket set and
+        build its lookup targets WITHOUT running the probes.
 
-        ``buckets`` overrides the scheduler (the equivalence tests
-        drive tracked and untracked engines over one explicit
-        schedule).  The record carries the fold's statistics plus the
-        derived coverage / freshness-percentile / lag fields; with the
-        plane off it carries only the sweep geometry.
+        The split half of :meth:`sweep` the soak engine rides
+        (``models.soak``): it admits the returned targets as
+        micro-batches into free serve slots over several bursts, then
+        closes the sweep with :meth:`finish_sweep` once every probe
+        retired.  ``sweep_idx`` is NOT bumped here — kills recorded
+        while the sweep is in flight stamp the in-progress index, which
+        is what keeps the ``period + miss_limit - 1`` lag bound valid
+        for interleaved sweeps too.
         """
-        s = self.sweep_idx
         if buckets is None:
             buckets = self.select_buckets()
         buckets = np.asarray(buckets)
-        targets = bucket_targets(buckets, self.mcfg.depth)
-        res = self._run_lookup(targets, key)
+        return buckets, bucket_targets(buckets, self.mcfg.depth)
+
+    def finish_sweep(self, found: jax.Array, buckets,
+                     done_frac: float = 1.0,
+                     hops=None) -> dict:
+        """Fold one sweep's probe results and close the sweep.
+
+        ``found``: the sweep's ``[S, quorum]`` discovered node indices
+        (-1 pad — an expired/unfinished probe row folds as all-missed,
+        exactly like a probe that found nobody); ``buckets``: the
+        ``begin_sweep`` set, in the same row order; ``hops``: optional
+        per-probe convergence rounds folded into the engine's hop
+        histogram (the fidelity instrument; omit for probes that never
+        converged).  Returns the sweep record and bumps ``sweep_idx``.
+        """
+        s = self.sweep_idx
+        buckets = np.asarray(buckets)
         record = {"sweep": s, "buckets_probed": int(len(buckets)),
                   "lookups": int(len(buckets)),
-                  "done_frac": float(np.asarray(res.done).mean())}
+                  "done_frac": float(done_frac)}
         if self.fresh is not None:
             probed = np.zeros((self.n_buckets,), bool)
             probed[buckets] = True
             self.fresh, stats, age_hist, bcounts = fold_sweep(
-                self.fresh, res.found, jnp.asarray(probed),
+                self.fresh, jnp.asarray(found), jnp.asarray(probed),
                 self.swarm.ids[:, 0], dev_i32(s), self.swarm.alive,
                 self.kill_sweep, self.mcfg)
             stats, age_hist, bcounts = jax.device_get(
@@ -433,13 +451,15 @@ class MonitorEngine:
             record["age_p50"] = _percentile_from_hist(age_hist, 0.50)
             record["age_p99"] = _percentile_from_hist(age_hist, 0.99)
             record["nodes_fresh"] = int(age_hist[0])
-        hist = np.asarray(hop_histogram(res.hops, self.cfg.max_steps),
-                          np.int64)
-        self.hop_hist += hist
-        if self.hop_hist_initial is None:
-            self.hop_hist_initial = hist
-            self.initial_alive = int(np.asarray(
-                jnp.sum(self.swarm.alive.astype(jnp.int32))))
+        if hops is not None:
+            hist = np.asarray(
+                hop_histogram(jnp.asarray(hops), self.cfg.max_steps),
+                np.int64)
+            self.hop_hist += hist
+            if self.hop_hist_initial is None:
+                self.hop_hist_initial = hist
+                self.initial_alive = int(np.asarray(
+                    jnp.sum(self.swarm.alive.astype(jnp.int32))))
         if s == 0:
             # Phase-jitter the due dates off the initial full crawl so
             # steady-state sweeps probe ~G/period buckets instead of
@@ -451,4 +471,25 @@ class MonitorEngine:
             self.last_probed[buckets] = s
         self.sweep_idx = s + 1
         self.records.append(record)
+        return record
+
+    def sweep(self, key: jax.Array, buckets=None
+              ) -> tuple[dict, LookupResult]:
+        """Run one monitoring sweep; returns ``(record, result)``.
+
+        ``buckets`` overrides the scheduler (the equivalence tests
+        drive tracked and untracked engines over one explicit
+        schedule).  The record carries the fold's statistics plus the
+        derived coverage / freshness-percentile / lag fields; with the
+        plane off it carries only the sweep geometry.  Implemented as
+        ``begin_sweep`` → one closed-loop probe batch →
+        ``finish_sweep`` — the soak engine runs the same two halves
+        with the probe batch spread over serve bursts instead.
+        """
+        buckets, targets = self.begin_sweep(buckets)
+        res = self._run_lookup(targets, key)
+        record = self.finish_sweep(
+            res.found, buckets,
+            done_frac=float(np.asarray(res.done).mean()),
+            hops=res.hops)
         return record, res

@@ -229,7 +229,8 @@ def _scatter_admission(st: LookupState, new: LookupState,
 
 def poisson_zipf_events(rate: float, duration: float, key_pool: int,
                         zipf_s: float, seed: int = 0,
-                        hot_frac: float = 0.01):
+                        hot_frac: float = 0.01,
+                        return_draw: bool = False):
     """Open-loop request schedule: Poisson(``rate``) arrival timestamps
     over ``[0, duration)`` with Zipf(``zipf_s``)-popular keys drawn
     from a ``key_pool``-key universe (``zipf_s = 0`` → uniform).
@@ -237,7 +238,11 @@ def poisson_zipf_events(rate: float, duration: float, key_pool: int,
     Returns ``(arrival_ts [R] float64, keys [R,5] uint32 jnp,
     klass [R] array of "hot"/"cold")`` — a key is "hot" when its
     popularity rank falls in the top ``hot_frac`` of the pool, the
-    request-class axis of the latency histograms.
+    request-class axis of the latency histograms.  With
+    ``return_draw`` the per-request popularity RANKS ride along as a
+    fourth element (the soak schedule derives its scan windows from
+    them, ``models.soak.mixed_events``) — the first three are
+    bit-identical either way.
     """
     if rate <= 0 or duration <= 0:
         raise ValueError("rate and duration must be > 0")
@@ -267,14 +272,41 @@ def poisson_zipf_events(rate: float, duration: float, key_pool: int,
     # micro-batch on the host and ships ONE padded array to the device
     # — a jnp key matrix here would put a device gather + blocking
     # readback + re-upload inside every admission of the measured loop.
+    if return_draw:
+        return ts, pool[draw], klass, draw
     return ts, pool[draw], klass
+
+
+def warm_serve_engine(engine: ServeEngine) -> None:
+    """Compile admit/step/snapshot/expire OFF the serve clock (compile
+    time must never masquerade as queueing delay).  Shared by
+    :func:`serve_open_loop` and the soak loop
+    (``models.soak.soak_open_loop``), which must warm the identical
+    program set so a maintenance-off soak is bit-identical to the
+    plain serve loop from the first admission on."""
+    c, a_cap = engine.slots, engine.admit_cap
+    st = engine.empty()
+    warm_keys = jnp.zeros((a_cap, N_LIMBS), jnp.uint32)
+    warm_slots = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.full((a_cap - 1,), c, jnp.int32)]) if a_cap > 1 \
+        else jnp.zeros((1,), jnp.int32)
+    st = engine.admit(st, warm_keys, warm_slots,
+                      jax.random.PRNGKey(0), 0)
+    st = engine.step(st, 0)
+    engine.snapshot(st)
+    # Expire compiles too: its first real use is mid-run by definition
+    # (a request aging past max_steps), where a fresh jit would land
+    # inside a burst wall mark and read as tail latency.
+    engine.expire(st, jnp.full((a_cap,), c, jnp.int32))
 
 
 def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
                     klass=None, burst: int = 2,
                     duration: float | None = None,
                     overload_queue_factor: int = 8,
-                    drain_round_cap: int | None = None) -> dict:
+                    drain_round_cap: int | None = None,
+                    clock=None, sleep=None) -> dict:
     """Drive the serve engine against an open-loop arrival schedule.
 
     ``arrival_ts``/``keys``(/``klass``) come from
@@ -294,10 +326,19 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
     are reported as ``in_flight`` — the checker's ``admitted ==
     completed + in_flight + expired`` conservation still holds).
 
+    ``clock``/``sleep`` inject the time source (defaults:
+    ``time.perf_counter`` / ``time.sleep``).  A deterministic virtual
+    clock makes the whole loop — admission decisions, burst marks, the
+    reconstructed latency samples — a pure function of the schedule,
+    which is how ``tests/test_soak.py`` proves the soak loop's
+    maintenance-off path BIT-identical to this one.
+
     Returns the serve report dict (see the module docstring for the
     latency reconstruction); per-request arrays are ordered by
     completion observation.
     """
+    clock = clock or time.perf_counter
+    sleep = sleep or time.sleep
     cfg, c = engine.cfg, engine.slots
     a_cap = engine.admit_cap
     keys = np.asarray(keys)        # host-side: see poisson_zipf_events
@@ -311,21 +352,8 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
     # horizon is overloaded whatever the queue gauge says.
     hard_wall = duration * 5.0 + 30.0
 
-    # --- warm pass: compile admit/step/snapshot off the clock.
-    st = engine.empty()
-    warm_keys = jnp.zeros((a_cap, N_LIMBS), jnp.uint32)
-    warm_slots = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32),
-         jnp.full((a_cap - 1,), c, jnp.int32)]) if a_cap > 1 \
-        else jnp.zeros((1,), jnp.int32)
-    st = engine.admit(st, warm_keys, warm_slots,
-                      jax.random.PRNGKey(0), 0)
-    st = engine.step(st, 0)
-    engine.snapshot(st)
-    # Expire compiles too: its first real use is mid-run by definition
-    # (a request aging past max_steps), where a fresh jit would land
-    # inside a burst wall mark and read as tail latency.
-    st = engine.expire(st, jnp.full((a_cap,), c, jnp.int32))
+    # --- warm pass: compile admit/step/snapshot/expire off the clock.
+    warm_serve_engine(engine)
     st = engine.empty()
 
     free = list(range(c - 1, -1, -1))     # pop() → lowest slot first
@@ -345,9 +373,9 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
     drain_rounds = 0
     overload = overload_queue_factor * c
 
-    t0 = time.perf_counter()
+    t0 = clock()
     while True:
-        now = time.perf_counter() - t0
+        now = clock() - t0
         while next_ev < r_total and arrival_ts[next_ev] <= now:
             queue.append(next_ev)
             next_ev += 1
@@ -392,9 +420,9 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
             # Idle gap between arrivals: sleep to the next event rather
             # than spinning dispatches on an empty state.
             if next_ev < r_total:
-                gap = arrival_ts[next_ev] - (time.perf_counter() - t0)
+                gap = arrival_ts[next_ev] - (clock() - t0)
                 if gap > 0:
-                    time.sleep(min(gap, 0.05))
+                    sleep(min(gap, 0.05))
                 continue
             break
 
@@ -403,7 +431,7 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
             st = engine.step(st, rnd)
             rnd += 1
         done, hops, adm_r, com_r, found = engine.snapshot(st)
-        w = time.perf_counter() - t0
+        w = clock() - t0
         marks_r.append(rnd)
         marks_w.append(w)
         occ_samples.append(len(occupied) / c)
@@ -456,7 +484,7 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
             if drain_rounds > drain_cap:
                 break
 
-    elapsed = time.perf_counter() - t0
+    elapsed = clock() - t0
     return {
         "slots": c,
         "admit_cap": a_cap,

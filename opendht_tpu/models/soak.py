@@ -1,0 +1,1321 @@
+"""Always-on node soak: one engine serving, maintaining and monitoring.
+
+Every capability before this module is a separate closed bench mode —
+serve (PR 7), republish maintenance (PR 10's compacted sweep), the
+monitor's incremental crawl (PR 8).  A real node runs them ALL AT ONCE:
+the reference's time-ordered scheduler loop
+(include/opendht/scheduler.h:38-123) interleaves listen refreshes,
+storage republish and maintenance jobs between answering queries, and
+the number that matters is what that interleaving does to the serve
+tail.  This module is the device twin of that loop, built so the
+interference is MEASURED, not guessed: the PR-10 compacted republish
+sweep is 5.73 s standalone at the r06 profile config — interleaved
+into free serve slots it should cost milliseconds per burst, and the
+unified timeline (``obs.timeline``) is where that claim is checked.
+
+Architecture — the :class:`SoakEngine` wraps the PR-7 slot-recycled
+:class:`~opendht_tpu.models.serve.ServeEngine` and adds:
+
+* **a per-slot work-class plane** — a resident ``[C] int32`` array
+  tagging every slot's occupant class (read / write / republish /
+  monitor), maintained by the same mechanism as the lifecycle plane:
+  donated scatters at admission (:func:`_scatter_wclass`,
+  :func:`_admit_maintenance`), one fused per-burst readback
+  (:func:`_soak_snapshot`) returning per-class ACTIVE slot counts next
+  to the serve harvest.  The plane is what lets the timeline split
+  slot-rounds serve-vs-maintenance per interval, and lets the checker
+  hold the device's view against the host's slot bookkeeping (a
+  mismatch fails the artifact).
+* **maintenance micro-batching** — a republish sweep no longer calls
+  the closed-loop ``lookup`` on its whole compacted batch: the sweep's
+  live rows are extracted once (the PR-10 ``_repub_live`` /
+  ``_repub_extract_rows`` compaction, verbatim), then admitted into
+  FREE serve slots a micro-batch at a time, strictly AFTER queued
+  serve requests.  Completed rows INSERT at their harvest, a
+  micro-batch at a time (:func:`_repub_insert_completed` — the
+  one-shot sweep-close insert was the measured residual stall on the
+  serve tail), with replica stats accumulating on device; the sweep
+  close is pure bookkeeping.  Monitor sweeps ride the same admission
+  path with a device-side sighting buffer instead
+  (:func:`_fold_completed`, the interleaved sweep fold):
+  ``MonitorEngine.begin_sweep`` picks the stale buckets, probes run
+  through serve slots, and ``finish_sweep`` folds the buffer with its
+  conservation identities intact.  Listener-refresh
+  and TTL expiry are slot-free single-program store sweeps, run on
+  their own cadence and booked (with walls) as maintenance ops.
+* **a scenario engine** — churn, routing-table heal and a contiguous
+  keyspace outage injected DURING serving by wall-clock events
+  (:class:`ScenarioEvent`), with ground-truth kills recorded through
+  the monitor's kill ledger so detection lag stays measurable against
+  the PR-8 scheduler bound.
+
+The loop is clock-injectable end to end (``clock``/``sleep``), and its
+maintenance-off path is BIT-identical to
+:func:`~opendht_tpu.models.serve.serve_open_loop` on the same schedule
+— same admissions, same marks arithmetic, same latency samples —
+asserted in ``tests/test_soak.py``: the soak wrapper is provably a
+pure superset of the serve engine.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.xor_metric import N_LIMBS
+from ..utils.hostdevice import dev_i32, dev_u32
+from . import storage as _storage
+from .monitor import MonitorEngine, kill_node_range
+from .serve import (
+    ServeEngine,
+    ServeOverloadError,
+    _scatter_rows_into,
+    poisson_zipf_events,
+    warm_serve_engine,
+)
+from .swarm import (
+    Swarm,
+    SwarmConfig,
+    _finalize,
+    _local_respond,
+    _sample_origins,
+    churn,
+    heal_swarm,
+    init_impl,
+)
+
+# Work classes of the per-slot plane.  READ/WRITE are the serve side
+# (open-loop client requests); REPUB/MONITOR are the maintenance side
+# (republish rows and crawl probes admitted into free slots).  Index
+# range scans ride the arrival stream too but execute through the trie
+# engine, not through slots — they have their own lifecycle counters.
+WC_READ = 0
+WC_WRITE = 1
+WC_REPUB = 2
+WC_MONITOR = 3
+N_WORK_CLASSES = 4
+WORK_CLASS_NAMES = ("read", "write", "repub", "monitor")
+SERVE_CLASSES = (WC_READ, WC_WRITE)
+MAINT_CLASSES = (WC_REPUB, WC_MONITOR)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_wclass(wc: jax.Array, slots: jax.Array,
+                    cls: jax.Array) -> jax.Array:
+    """Tag admitted slots with their work class — the plane twin of the
+    admission scatter (slot sentinel ``C`` dropped, like every
+    admission program).  ``cls`` is ``[A]`` (per-slot classes: one
+    serve micro-batch can mix reads and writes) or scalar; the plane
+    buffer is DONATED — single-owner like the serve carry."""
+    return wc.at[slots].set(jnp.asarray(cls, jnp.int32), mode="drop")
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))
+def _admit_maintenance(swarm: Swarm, cfg: SwarmConfig, st, wc,
+                       pool_keys: jax.Array, pool_idx: jax.Array,
+                       slots: jax.Array, origins: jax.Array,
+                       rnd: jax.Array, cls: jax.Array):
+    """Admit one maintenance micro-batch into free serve slots.
+
+    The maintenance twin of ``serve._admit``, fused with the
+    work-class tag: ``pool_keys [W,5]`` is the sweep's resident key
+    pool (republish rows' value keys, or the monitor sweep's bucket
+    targets), ``pool_idx [A]`` the rows this micro-batch admits (pad
+    ``-1`` — clipped for the gather, dropped by the slot sentinel),
+    ``slots [A]`` the target slots (pad sentinel ``C``), ``cls`` the
+    work class.  Keys never round-trip through the host: the gather
+    happens HERE, against the pool that was extracted on device at
+    sweep begin.  State and plane are both DONATED.
+    """
+    pkeys = pool_keys[jnp.clip(pool_idx, 0, pool_keys.shape[0] - 1)]
+    new = init_impl(swarm.ids, _local_respond(swarm, cfg), cfg, pkeys,
+                    origins)
+    st = _scatter_rows_into(st, new, slots, rnd)
+    wc = wc.at[slots].set(jnp.asarray(cls, jnp.int32), mode="drop")
+    return st, wc
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _fold_completed(buf: jax.Array, ids: jax.Array, st,
+                    cfg: SwarmConfig, sl: jax.Array,
+                    pos: jax.Array) -> jax.Array:
+    """The interleaved sweep fold: scatter completed slots' finalized
+    result heads into a sweep's device-side accumulation buffer.
+
+    ``buf [W, quorum]`` (``-1`` init — an unfolded row reads as a
+    probe that found nobody), ``sl [A]`` the harvested slots (pad
+    ``0``, clipped — the matching ``pos`` sentinel drops the row),
+    ``pos [A]`` each slot's row position within the sweep (pad
+    sentinel ``W``).  The heads are recomputed from the LIVE state
+    (the same ``_finalize`` the snapshot runs), so the sweep's data
+    plane never round-trips through the host — a republish sweep's
+    announce targets and a monitor sweep's sighting sets accumulate
+    across bursts entirely on device.  The buffer is DONATED; the
+    state is read-only (it stays resident in the loop).
+    """
+    fin = _finalize(ids, st, cfg)
+    heads = fin[jnp.clip(sl, 0, st.done.shape[0] - 1)]
+    return buf.at[pos].set(heads, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("cfg", "scfg"),
+         donate_argnums=(4, 15))
+def _repub_insert_completed(ids: jax.Array, alive: jax.Array,
+                            cfg: SwarmConfig, scfg, store, st,
+                            sl: jax.Array, pos: jax.Array,
+                            pool_keys: jax.Array, vals: jax.Array,
+                            seqs: jax.Array, sizes: jax.Array,
+                            ttls: jax.Array, payloads: jax.Array,
+                            okf: jax.Array, acc: jax.Array,
+                            now: jax.Array):
+    """The republish half of the interleaved sweep fold: INSERT a
+    harvested micro-batch of completed republish rows straight into
+    the store, instead of accumulating them for one stop-the-world
+    insert at sweep close.
+
+    The one-shot close insert was the measured residual interference
+    (a ~sweep-wide ``_announce_insert`` lands as one multi-hundred-ms
+    stall on the serve tail); this program is its micro-batch twin:
+    ``sl [A]`` harvested slots / ``pos [A]`` sweep row positions (pad
+    sentinel ``W`` → masked), the announce heads recomputed from the
+    live state (``_finalize``, as in :func:`_fold_completed`), the
+    row's key/value/seq/ttl gathered from the sweep's device pools,
+    and dead-slot rows masked exactly like ``_mask_unowned``.  The
+    store and the ``[3]`` replica accumulator (count, sum, min over
+    live rows) are DONATED; the replica stats surface at sweep close
+    with zero extra syncs.
+    """
+    w = pool_keys.shape[0]
+    fin = _finalize(ids, st, cfg)
+    heads = fin[jnp.clip(sl, 0, st.done.shape[0] - 1)]     # [A,q]
+    p_safe = jnp.clip(pos, 0, w - 1)
+    ok = (pos >= 0) & (pos < w) & okf[p_safe]
+    found = jnp.where(ok[:, None], heads, -1)
+    keys = pool_keys[p_safe]
+    store, rep, _trace = _storage._announce_insert(
+        alive, cfg, store, scfg, found, keys, vals[p_safe],
+        seqs[p_safe], now, sizes[p_safe], ttls[p_safe],
+        payloads[p_safe])
+    acc = jnp.stack([
+        acc[0] + jnp.sum(ok.astype(jnp.int32)),
+        acc[1] + jnp.sum(jnp.where(ok, rep, 0)),
+        jnp.minimum(acc[2], jnp.min(jnp.where(ok, rep, 2 ** 30))),
+    ])
+    return store, acc
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _soak_snapshot(swarm: Swarm, cfg: SwarmConfig, st, wc: jax.Array):
+    """The soak harvest readback: the serve snapshot plus the
+    work-class plane's per-class ACTIVE slot counts (not-done slots
+    only — a free slot's stale tag is masked by ``done``).  The counts
+    are the device's own occupancy-split testimony: the timeline books
+    them against the host's slot bookkeeping and the checker fails any
+    interval where the two disagree."""
+    active = ~st.done
+    cls_idx = jnp.where(active, wc, N_WORK_CLASSES)
+    counts = jnp.zeros((N_WORK_CLASSES,), jnp.int32).at[cls_idx].add(
+        1, mode="drop")
+    return (st.done, st.hops, st.admitted_round, st.completed_round,
+            _finalize(swarm.ids, st, cfg), counts)
+
+
+class SoakConfig(NamedTuple):
+    """Host-side soak policy (wall-clock cadences in seconds).
+
+    * ``interval_s`` — timeline interval width (the unit of every
+      per-interval row, conservation check and interference
+      attribution);
+    * ``repub_period_s`` — gap between the END of one republish sweep
+      and the begin of the next (``Dht::dataPersistence`` runs on a
+      timer; here the timer re-arms once the previous sweep drained);
+    * ``monitor_gap_s`` — same, for monitor sweeps (0 = continuous
+      crawling: a sweep begins as soon as the previous finishes);
+    * ``listen_period_s`` — cadence of the slot-free store sweeps
+      (listener refresh + TTL expiry), booked as maintenance ops;
+    * ``maint_cap`` — maintenance rows admitted per loop iteration at
+      most (into free slots only, after serve admission);
+    * ``maint_slot_frac`` — hard ceiling on the fraction of slots
+      maintenance may OCCUPY at once (the serve engine's admission
+      reserve: serve requests admit first every iteration, and
+      maintenance can never crowd the slot plane past this share —
+      without it a continuous crawl saturates the slots and queueing
+      delay books as serve tail latency);
+    * ``write_flush`` — completed write requests batched per
+      ``_announce_insert`` flush (also that program's compiled width);
+    * ``scan_batch`` / ``scan_max_wait_s`` — scan-station batching:
+      flush when this many scans are pending or the oldest has waited
+      this long.
+    """
+    interval_s: float = 0.5
+    repub_period_s: float = 1.0
+    monitor_gap_s: float = 0.0
+    listen_period_s: float = 1.0
+    maint_cap: int = 256
+    maint_slot_frac: float = 0.25
+    write_flush: int = 256
+    scan_batch: int = 16
+    scan_max_wait_s: float = 0.25
+
+
+class _Sweep:
+    """One in-flight maintenance sweep (host state machine).
+
+    Rows live in ``keys_dev [total, 5]`` (device); ``cursor`` is the
+    admission frontier (``cursor == admitted`` always — rows admit in
+    pool order); ``buf [total, quorum]`` accumulates completed rows'
+    result heads via :func:`_fold_completed` (monitor sweeps; repub
+    sweeps insert incrementally via :func:`_repub_insert_completed`
+    and carry no buffer).  The sweep closes when every row was
+    admitted and retired (completed or expired)."""
+
+    __slots__ = ("cls", "keys_dev", "total", "cursor", "buf",
+                 "completed", "expired", "admitted", "began_t",
+                 "meta", "hops", "done_rows")
+
+    def __init__(self, cls: int, keys_dev, buf, began_t: float,
+                 meta=None):
+        self.cls = cls
+        self.keys_dev = keys_dev
+        self.total = int(keys_dev.shape[0])
+        self.cursor = 0
+        self.buf = buf
+        self.completed = 0
+        self.expired = 0
+        self.admitted = 0
+        self.began_t = began_t
+        self.meta = meta or {}
+        self.hops: list[int] = []
+        self.done_rows: list[int] = []   # completed row positions
+
+    @property
+    def retired(self) -> int:
+        return self.completed + self.expired
+
+    @property
+    def drained(self) -> bool:
+        return self.cursor >= self.total \
+            and self.retired >= self.admitted
+
+
+class SoakEngine:
+    """The always-on node: one resident serve state, one work-class
+    plane, a value store under maintenance, and a monitor plane — all
+    advanced by one host loop (:func:`soak_open_loop`).
+
+    ``store``/``scfg`` arm the republish + listener maintenance (and
+    the write-request flush path); ``monitor`` (a
+    :class:`~opendht_tpu.models.monitor.MonitorEngine` built on the
+    SAME swarm) arms the interleaved crawl; ``index`` (a
+    ``models.index.DeviceIndex``) plus ``scan_key_fn`` (rank → index
+    key dict) arm the scan station.  Any of them may be ``None`` —
+    with all three off the engine degrades to exactly the PR-7 serve
+    engine (the pure-superset equivalence ``tests/test_soak.py``
+    pins).
+
+    The engine OWNS its swarm: churn/heal/outage donate or replace
+    swarm buffers, and the serve/monitor halves are re-pointed at the
+    new pytree after every scenario event (:meth:`_sync_swarm`).
+    """
+
+    def __init__(self, swarm: Swarm, cfg: SwarmConfig, slots: int,
+                 scfg=None, store=None,
+                 monitor: Optional[MonitorEngine] = None,
+                 index=None, scan_key_fn=None,
+                 admit_cap: int | None = None,
+                 soak_cfg: SoakConfig | None = None,
+                 maint_key: jax.Array | None = None):
+        self.swarm, self.cfg = swarm, cfg
+        self.serve = ServeEngine(swarm, cfg, slots,
+                                 admit_cap=admit_cap)
+        self.scfg, self.store = scfg, store
+        self.mon = monitor
+        self.index = index
+        self.scan_key_fn = scan_key_fn
+        self.soak_cfg = soak_cfg or SoakConfig()
+        self.maint_key = (maint_key if maint_key is not None
+                          else jax.random.PRNGKey(0x50AC))
+        self.wc = jnp.zeros((slots,), jnp.int32)
+        self._madm_i = 0
+        self._warmed_admit: set[int] = set()
+        self._warmed_fold: set[int] = set()
+        self._warmed_insert: set[int] = set()
+        self._warmed_mon_finish: set[int] = set()
+        self.repub_records: list[dict] = []
+        self.maint_ops: list[dict] = []
+        self.store_now = 1        # uint32 store clock (announce epochs)
+        self._listen_active = None
+
+    # ------------------------------------------------------------------
+    # plumbing
+    # ------------------------------------------------------------------
+
+    def _sync_swarm(self, swarm: Swarm) -> None:
+        self.swarm = swarm
+        self.serve.swarm = swarm
+        if self.mon is not None:
+            self.mon.swarm = swarm
+
+    def snapshot(self, st):
+        return jax.device_get(
+            _soak_snapshot(self.swarm, self.cfg, st, self.wc))
+
+    def admit_serve(self, st, keys, slots, cls_np, key, rnd):
+        """Serve-side admission: the UNMODIFIED serve admit (so the
+        maintenance-off path stays bit-identical to the serve engine)
+        plus one work-class scatter on the plane."""
+        st = self.serve.admit(st, keys, slots, key, rnd)
+        self.wc = _scatter_wclass(self.wc, slots,
+                                  jnp.asarray(cls_np, jnp.int32))
+        return st
+
+    def admit_maintenance(self, st, sweep: _Sweep, pool_idx_np,
+                          slots_np, rnd):
+        origins = _sample_origins(
+            jax.random.fold_in(self.maint_key, self._madm_i),
+            self.swarm.alive, self.serve.admit_cap)
+        self._madm_i += 1
+        st, self.wc = _admit_maintenance(
+            self.swarm, self.cfg, st, self.wc, sweep.keys_dev,
+            jnp.asarray(pool_idx_np), jnp.asarray(slots_np), origins,
+            dev_i32(rnd), dev_i32(sweep.cls))
+        return st
+
+    def fold_completed(self, sweep: _Sweep, st, sl_np, pos_np):
+        sweep.buf = _fold_completed(
+            sweep.buf, self.swarm.ids, st, self.cfg,
+            jnp.asarray(sl_np), jnp.asarray(pos_np))
+
+    def insert_completed(self, sweep: _Sweep, st, sl_np, pos_np):
+        """Micro-batch republish insert at harvest (the repub half of
+        the interleaved fold — store and replica accumulator donated
+        through)."""
+        meta = sweep.meta
+        self.store, meta["acc"] = _repub_insert_completed(
+            self.swarm.ids, self.swarm.alive, self.cfg, self.scfg,
+            self.store, st, jnp.asarray(sl_np), jnp.asarray(pos_np),
+            sweep.keys_dev, meta["vals"], meta["seqs"], meta["sizes"],
+            meta["ttls"], meta["payloads"], meta["okf"], meta["acc"],
+            meta["now_u"])
+
+    def warm_sweep_width(self, st, width: int) -> None:
+        """Compile the admission/fold programs for a sweep width at
+        sweep BEGIN (off the burst marks): a fresh jit inside a burst
+        clock would book as serve tail latency and be attributed to
+        the wrong cause.  Throwaway operands; the resident state is
+        never touched.  Sweep widths are power-of-two rungs (the
+        republish compaction and the bucket scheduler both round up),
+        so the specialization count stays logarithmic."""
+        c, a_cap = self.serve.slots, self.serve.admit_cap
+        if width not in self._warmed_admit:
+            tmp = self.serve.empty()
+            twc = jnp.zeros((c,), jnp.int32)
+            pool = jnp.zeros((width, N_LIMBS), jnp.uint32)
+            _admit_maintenance(
+                self.swarm, self.cfg, tmp, twc, pool,
+                jnp.full((a_cap,), -1, jnp.int32),
+                jnp.full((a_cap,), c, jnp.int32),
+                _sample_origins(self.maint_key, self.swarm.alive,
+                                a_cap),
+                dev_i32(0), dev_i32(WC_REPUB))
+            self._warmed_admit.add(width)
+        if width not in self._warmed_fold:
+            _fold_completed(
+                jnp.full((width, self.cfg.quorum), -1, jnp.int32),
+                self.swarm.ids, st, self.cfg,
+                jnp.zeros((a_cap,), jnp.int32),
+                jnp.full((a_cap,), width, jnp.int32))
+            self._warmed_fold.add(width)
+
+    def warm(self, st) -> None:
+        """Compile the fixed-width soak programs off the clock (the
+        per-sweep-width programs warm at sweep begin)."""
+        c, a_cap = self.serve.slots, self.serve.admit_cap
+        self.wc = _scatter_wclass(
+            self.wc, jnp.full((a_cap,), c, jnp.int32),
+            jnp.zeros((a_cap,), jnp.int32))
+        self.snapshot(st)
+
+    def warm_repub_insert(self, st, width: int) -> None:
+        """Compile the micro-batch republish insert at a sweep pool
+        width with a fully-masked batch (every ``pos`` is the pad
+        sentinel → announce to nobody: store content untouched, only
+        the donated buffers turn over)."""
+        if width in self._warmed_insert:
+            return
+        cfg, scfg = self.cfg, self.scfg
+        a_cap = self.serve.admit_cap
+        z32 = jnp.zeros((width,), jnp.uint32)
+        self.store, _acc = _repub_insert_completed(
+            self.swarm.ids, self.swarm.alive, cfg, scfg, self.store,
+            st, jnp.zeros((a_cap,), jnp.int32),
+            jnp.full((a_cap,), width, jnp.int32),
+            jnp.zeros((width, N_LIMBS), jnp.uint32), z32, z32, z32,
+            z32, jnp.zeros((width, scfg.payload_words), jnp.uint32),
+            jnp.zeros((width,), bool),
+            jnp.asarray([0, 0, 2 ** 30], jnp.int32),
+            dev_u32(self.store_now))
+        self._warmed_insert.add(width)
+
+    def warm_monitor_finish(self, width: int) -> None:
+        """Compile the sweep-close fold at a sweep width against a
+        THROWAWAY freshness state (the donated operand), so the first
+        on-clock ``finish_sweep`` of that width runs pre-compiled."""
+        if width in self._warmed_mon_finish or self.mon is None:
+            return
+        from .monitor import empty_freshness, fold_sweep
+        n = self.cfg.n_nodes
+        dummy = empty_freshness(n)
+        fold_sweep(dummy,
+                   jnp.full((width, self.cfg.quorum), -1, jnp.int32),
+                   jnp.zeros((self.mon.n_buckets,), bool),
+                   self.swarm.ids[:, 0], dev_i32(0), self.swarm.alive,
+                   self.mon.kill_sweep, self.mon.mcfg)
+        self._warmed_mon_finish.add(width)
+
+    # ------------------------------------------------------------------
+    # republish sweeps (maintenance work class)
+    # ------------------------------------------------------------------
+
+    def begin_repub_sweep(self, st, t: float) -> Optional[_Sweep]:
+        """Open a republish sweep: the PR-10 compacted extract, kept on
+        device as the sweep's admission pool.  Returns ``None`` when
+        the store holds no live rows (nothing to maintain)."""
+        cfg, scfg = self.cfg, self.scfg
+        node_idx = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+        m = cfg.n_nodes * scfg.slots
+        order, nlive_d = _storage._repub_live(
+            self.swarm.alive, self.store, node_idx, cfg, scfg)
+        n_live = int(jax.device_get(nlive_d))
+        if n_live == 0:
+            return None
+        wdt = min(m, _storage.pow2_width(
+            n_live, _storage._REPUB_COMPACT_FLOOR))
+        if wdt < m:
+            keys, vals, seqs, sizes, ttls, payloads, okf = \
+                _storage._repub_extract_rows(
+                    self.swarm.alive, self.store, node_idx,
+                    order[:wdt], cfg, scfg)
+        else:
+            keys, vals, seqs, sizes, ttls, payloads, okf = \
+                _storage._repub_extract(
+                    self.swarm.alive, self.store, node_idx, cfg, scfg)
+        w = int(keys.shape[0])
+        self.warm_sweep_width(st, w)
+        self.warm_repub_insert(st, w)
+        now_u = dev_u32(self.store_now)
+        self.store_now += 1
+        return _Sweep(WC_REPUB, keys, None, t,
+                      meta={"vals": vals, "seqs": seqs, "sizes": sizes,
+                            "ttls": ttls, "payloads": payloads,
+                            "okf": okf, "n_live": n_live,
+                            "batch_rows": m, "now_u": now_u,
+                            "acc": jnp.asarray([0, 0, 2 ** 30],
+                                               jnp.int32)})
+
+    def finish_repub_sweep(self, sw: _Sweep, t: float) -> dict:
+        """Close a republish sweep: every completed row already
+        inserted at its harvest (``_repub_insert_completed``), so the
+        close is pure bookkeeping — one readback of the replica
+        accumulator."""
+        meta = sw.meta
+        n_rep, rep_sum, rep_min = (
+            int(v) for v in jax.device_get(meta["acc"]))
+        rec = {
+            "began_t": round(sw.began_t, 4),
+            "finished_t": round(t, 4),
+            "rows": sw.total,
+            "live_rows": meta["n_live"],
+            "batch_rows": meta["batch_rows"],
+            "admitted": sw.admitted,
+            "completed": sw.completed,
+            "expired": sw.expired,
+            "in_flight": sw.admitted - sw.completed - sw.expired,
+            "replicas_mean": round(
+                float(rep_sum) / max(1, int(n_rep)), 3),
+            "replicas_min": int(rep_min) if int(n_rep) else None,
+        }
+        self.repub_records.append(rec)
+        return rec
+
+    # ------------------------------------------------------------------
+    # monitor sweeps (monitor work class)
+    # ------------------------------------------------------------------
+
+    def begin_monitor_sweep(self, st, t: float) -> _Sweep:
+        buckets, targets = self.mon.begin_sweep()
+        w = int(targets.shape[0])
+        self.warm_sweep_width(st, w)
+        self.warm_monitor_finish(w)
+        return _Sweep(WC_MONITOR, targets,
+                      jnp.full((w, self.cfg.quorum), -1, jnp.int32),
+                      t, meta={"buckets": np.asarray(buckets)})
+
+    def finish_monitor_sweep(self, sw: _Sweep, t: float) -> dict:
+        """Close a monitor sweep: fold the accumulated sighting buffer.
+        Only COMPLETED probes' buckets count as probed — an expired
+        probe must not strike the nodes it never reached."""
+        buckets = sw.meta["buckets"]
+        probed = buckets[np.asarray(sorted(sw.done_rows), np.int64)] \
+            if sw.done_rows else np.zeros((0,), np.int64)
+        rec = self.mon.finish_sweep(
+            sw.buf, probed,
+            done_frac=sw.completed / max(1, sw.total),
+            hops=np.asarray(sw.hops, np.int64) if sw.hops else None)
+        rec["began_t"] = round(sw.began_t, 4)
+        rec["finished_t"] = round(t, 4)
+        rec["probes"] = sw.total
+        rec["admitted_probes"] = sw.admitted
+        rec["expired_probes"] = sw.expired
+        rec["in_flight_probes"] = \
+            sw.admitted - sw.completed - sw.expired
+        return rec
+
+    # ------------------------------------------------------------------
+    # slot-free maintenance ops (listener refresh / TTL expiry)
+    # ------------------------------------------------------------------
+
+    def run_store_sweeps(self, t: float, clock,
+                         book: bool = True) -> dict:
+        """The reference's periodic jobs with no lookup phase: listener
+        TTL refresh/expiry and value TTL expiry — single store-wide
+        programs, booked with their walls as maintenance ops
+        (``book=False`` = the pre-clock compile warm)."""
+        t0 = clock()
+        if self._listen_active is None:
+            # The soak node keeps every registration alive (the ~30 s
+            # keepalive of Dht::listenTo); a churn model for listener
+            # OWNERS would thread a real mask here.
+            self._listen_active = jnp.ones(
+                (self.scfg.max_listeners,), bool)
+        self.store = _storage.refresh_listeners(
+            self.store, self.scfg, self._listen_active,
+            self.store_now)
+        self.store = _storage.expire_listeners(self.store, self.scfg,
+                                               self.store_now)
+        self.store = _storage.expire(self.store, self.scfg,
+                                     self.store_now)
+        jax.block_until_ready(self.store.used)
+        rec = {"op": "listen-refresh+expire", "t": round(t, 4),
+               "wall_s": round(clock() - t0, 6)}
+        if book:
+            self.maint_ops.append(rec)
+        return rec
+
+
+def mixed_events(rate: float, duration: float, key_pool: int,
+                 zipf_s: float, seed: int = 0, hot_frac: float = 0.01,
+                 write_frac: float = 0.0, scan_frac: float = 0.0,
+                 scan_span: int = 64):
+    """The soak arrival schedule: :func:`poisson_zipf_events` plus an
+    op class per request (read / write / scan) and rank windows for
+    the scans.
+
+    Returns ``(arrival_ts [R], keys [R,5], klass [R] hot/cold,
+    ops [R] read/write/scan, scan_lo [R], scan_hi [R])``.  Scan
+    windows ride the same Zipf popularity as the keys (hot ranks get
+    scanned more — the arXiv:1009.3681 read-heavy shape); rows whose
+    op is not ``scan`` carry unused windows.
+    """
+    if not 0.0 <= write_frac <= 1.0 or not 0.0 <= scan_frac <= 1.0 \
+            or write_frac + scan_frac > 1.0:
+        raise ValueError(
+            f"scenario-mix fractions must be in [0, 1] with "
+            f"write + scan <= 1, got write={write_frac} "
+            f"scan={scan_frac}")
+    ts, keys, klass, draw = poisson_zipf_events(
+        rate, duration, key_pool, zipf_s, seed=seed,
+        hot_frac=hot_frac, return_draw=True)
+    r = len(ts)
+    rng = np.random.default_rng(seed ^ 0x50AC)
+    u = rng.random(r)
+    ops = np.where(u < scan_frac, "scan",
+                   np.where(u < scan_frac + write_frac, "write",
+                            "read"))
+    scan_lo = np.minimum(draw, key_pool - 1).astype(np.int64)
+    scan_hi = np.minimum(scan_lo + scan_span - 1, key_pool - 1)
+    return ts, keys, klass, ops, scan_lo, scan_hi
+
+
+class ScenarioEvent(NamedTuple):
+    """One scheduled fault: at wall second ``t`` (on the soak clock),
+    ``kind`` in ``{"churn", "outage"}`` kills ``frac`` of the
+    population — churn uniformly, outage as ONE contiguous sorted-id
+    range at the keyspace midpoint (the PR-8 localized outage, here
+    injected DURING serving).  Every event is followed by a routing
+    heal (the chaos-harness convention), and ground truth lands in the
+    monitor's kill ledger so detection lag stays measurable."""
+    t: float
+    kind: str
+    frac: float
+
+
+def _apply_event(soak: SoakEngine, ev: ScenarioEvent,
+                 ev_i: int) -> None:
+    cfg = soak.cfg
+    k_ev = jax.random.fold_in(soak.maint_key, 7000 + ev_i)
+    if ev.kind == "churn":
+        if soak.mon is not None:
+            soak.mon.kill(ev.frac, k_ev)
+            soak._sync_swarm(soak.mon.swarm)
+        else:
+            soak._sync_swarm(churn(soak.swarm, k_ev, ev.frac, cfg))
+    elif ev.kind == "outage":
+        n0 = cfg.n_nodes // 2
+        hi_n = n0 + int(cfg.n_nodes * ev.frac)
+        if soak.mon is not None:
+            soak.mon.kill_range(n0, hi_n)
+            soak._sync_swarm(soak.mon.swarm)
+        else:
+            soak._sync_swarm(kill_node_range(
+                soak.swarm, jnp.int32(n0), jnp.int32(hi_n), cfg))
+    else:
+        raise ValueError(f"unknown scenario event kind {ev.kind!r}")
+    k_heal = jax.random.fold_in(soak.maint_key, 8000 + ev_i)
+    if soak.mon is not None:
+        soak.mon.heal(k_heal)
+        soak._sync_swarm(soak.mon.swarm)
+    else:
+        soak._sync_swarm(heal_swarm(soak.swarm, cfg, k_heal))
+
+
+def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
+                   klass=None, ops=None, scan_lo=None, scan_hi=None,
+                   burst: int = 2, duration: float | None = None,
+                   overload_queue_factor: int = 8,
+                   drain_round_cap: int | None = None,
+                   maintenance: bool = True,
+                   scenario: tuple = (),
+                   timeline=None,
+                   latency_plane=None,
+                   clock=None, sleep=None) -> dict:
+    """Drive the soak engine against an open-loop arrival schedule.
+
+    The serve half of this loop is :func:`serve_open_loop`'s body —
+    same admission policy, same burst/harvest cadence, same marks
+    arithmetic, same expiry and overload contracts — so with
+    ``maintenance=False``, no monitor, no scans and an empty scenario
+    it produces BIT-identical results (``tests/test_soak.py``).  On
+    top of it:
+
+    * queued serve requests admit FIRST; remaining free slots take
+      maintenance micro-batches (monitor probes before republish rows
+      — detection lag is the bounded quantity), capped at
+      ``soak_cfg.maint_cap`` per iteration;
+    * ``scenario`` events (churn / outage + heal) fire by wall time at
+      the iteration top;
+    * ``timeline`` (an ``obs.timeline.SoakTimeline``) books every
+      burst, admission, completion and maintenance op into
+      per-wall-interval rows; ``latency_plane`` observes serve (and
+      scan) completions with an ``op`` label;
+    * ``maintenance=False`` is the interference A/B's off-arm: writes,
+      scans and the scenario still run (they are serve work and
+      environment), only republish/monitor/listener maintenance is
+      withheld;
+    * after the schedule drains, in-flight sweeps drain too (no new
+      sweeps begin), then close with partial folds — unadmitted rows
+      were never dispatched, so every conservation identity holds.
+
+    Returns the serve report (superset of ``serve_open_loop``'s keys)
+    plus per-class lifecycle counters, sweep records and scan-station
+    stats.
+    """
+    clock = clock or time.perf_counter
+    sleep = sleep or time.sleep
+    engine = soak.serve
+    scfg_soak = soak.soak_cfg
+    cfg, c = engine.cfg, engine.slots
+    a_cap = engine.admit_cap
+    keys = np.asarray(keys)
+    r_total = len(arrival_ts)
+    if klass is None:
+        klass = np.full(r_total, "all")
+    if ops is None:
+        ops = np.full(r_total, "read")
+    ops = np.asarray(ops)
+    if "write" in ops and soak.store is None:
+        raise ValueError("write requests need a store (scfg/store on "
+                         "the SoakEngine)")
+    if "scan" in ops and (soak.index is None
+                          or soak.scan_key_fn is None):
+        raise ValueError("scan requests need an index + scan_key_fn "
+                         "on the SoakEngine")
+    drain_cap = drain_round_cap or 4 * cfg.max_steps
+    if duration is None:
+        duration = float(arrival_ts[-1]) if r_total else 0.0
+    hard_wall = duration * 5.0 + 30.0
+    events = sorted(scenario, key=lambda e: e.t)
+    ev_i = 0
+    do_maint = maintenance and soak.store is not None
+    do_mon = maintenance and soak.mon is not None
+    if (do_maint or do_mon) \
+            and int(scfg_soak.maint_slot_frac * c) < 1:
+        raise ValueError(
+            f"maint_slot_frac {scfg_soak.maint_slot_frac} of {c} "
+            f"slots reserves no whole slot — maintenance could never "
+            f"admit a row; raise the fraction or the slot count")
+    do_scan = soak.index is not None and "scan" in ops
+    has_writes = "write" in ops
+    n_scan_sched = int(np.sum(ops == "scan")) if do_scan else 0
+
+    # --- warm pass: the serve programs (identical set — bit-identity
+    # depends on it), then the soak-only fixed-width programs.
+    warm_serve_engine(engine)
+    st = engine.empty()
+    soak.warm(st)
+    # Flush width must hold at least one fold chunk (chunks are
+    # admit-cap wide), or a single burst's completions could overflow
+    # the buffer between flush checks.
+    wf = max(scfg_soak.write_flush, a_cap)
+    if has_writes:
+        # Write-station warm: the fold at flush width and the insert
+        # program (a found=-1 insert writes nothing — same store
+        # content, fresh donated buffer).
+        _fold_completed(
+            jnp.full((wf, cfg.quorum), -1, jnp.int32),
+            soak.swarm.ids, st, cfg, jnp.zeros((a_cap,), jnp.int32),
+            jnp.full((a_cap,), wf, jnp.int32))
+        soak.store, _r, _t = _storage._announce_insert(
+            soak.swarm.alive, cfg, soak.store, soak.scfg,
+            jnp.full((wf, cfg.quorum), -1, jnp.int32),
+            jnp.zeros((wf, N_LIMBS), jnp.uint32),
+            jnp.zeros((wf,), jnp.uint32), jnp.zeros((wf,), jnp.uint32),
+            dev_u32(soak.store_now))
+    if do_scan:
+        pw = soak.index.spec.prefix_words
+        soak.index.range_query(np.zeros((1, pw), np.uint32),
+                               np.zeros((1, pw), np.uint32))
+    # Maintenance/scenario warm, all PRE-clock: the serve loop's
+    # contract — compile must never masquerade as queueing delay —
+    # applies doubly here, because an on-clock compile would book as
+    # MAINTENANCE interference and poison exactly the attribution this
+    # engine exists to measure.  Sweeps are pre-armed (their begin
+    # compiles the width-specialized admit/fold/close programs), the
+    # monitor's steady-state widths are warmed ahead, and a zero-kill
+    # churn + empty outage compiles the scenario path (both A/B arms
+    # run the identical warm, so the arms stay schedule-identical).
+    repub_sweep: Optional[_Sweep] = None
+    mon_sweep: Optional[_Sweep] = None
+    if do_maint:
+        soak.run_store_sweeps(0.0, clock, book=False)
+        repub_sweep = soak.begin_repub_sweep(st, 0.0)
+        if repub_sweep is not None:
+            # Writes grow the live-row pool, so a LATER sweep can land
+            # one power-of-two rung up — warm that rung's programs now
+            # (sweep widths only move in pow2 steps).
+            m_full = cfg.n_nodes * soak.scfg.slots
+            nxt = min(m_full, 2 * repub_sweep.total)
+            soak.warm_sweep_width(st, nxt)
+            soak.warm_repub_insert(st, nxt)
+    if do_mon:
+        mon_sweep = soak.begin_monitor_sweep(st, 0.0)
+        g, per = soak.mon.n_buckets, soak.mon.mcfg.period
+        budget_w = 1 << max(0, (-(-g // per) - 1)).bit_length()
+        for wdt in {min(g, budget_w), min(g, 2 * budget_w)}:
+            soak.warm_sweep_width(st, wdt)
+            soak.warm_monitor_finish(wdt)
+    if events:
+        _apply_event(soak, ScenarioEvent(-1.0, "churn", 0.0), -1)
+        _apply_event(soak, ScenarioEvent(-1.0, "outage", 0.0), -2)
+
+    free = list(range(c - 1, -1, -1))     # pop() → lowest slot first
+    # slot -> (work class, ref); ref = request index for serve slots,
+    # (sweep, row position) for maintenance slots.
+    occupied: dict[int, tuple] = {}
+    queue: list[int] = []
+    scan_queue: list[int] = []
+    next_ev = 0
+    rnd = 0
+    adm_i = 0
+    marks_r = [0]
+    marks_w = [0.0]
+    rec_req, rec_lat, rec_hops, rec_rounds, rec_found = [], [], [], [], []
+    admit_wall = {}
+    queue_depths = []
+    occ_samples = []
+    admitted = completed = expired = 0
+    adm_c = [0] * N_WORK_CLASSES
+    com_c = [0] * N_WORK_CLASSES
+    exp_c = [0] * N_WORK_CLASSES
+    drain_rounds = 0
+    overload = overload_queue_factor * c
+    wclass_mismatches = 0
+    maint_occupied = 0
+    next_repub_t = 0.0
+    next_mon_t = 0.0
+    next_listen_t = scfg_soak.listen_period_s if do_maint else None
+    repub_done_records: list[dict] = []
+    mon_sweep_records: list[dict] = []
+    # Write-flush station.
+    wbuf = jnp.full((wf, cfg.quorum), -1, jnp.int32) \
+        if has_writes else None
+    wpend: list[int] = []     # request indices folded into wbuf rows
+    write_seq: dict = {}
+    write_flushes = 0
+    write_flush_wall = 0.0
+    # Scan station.
+    scan_done, scan_lat, scan_entries = 0, [], 0
+    scan_flushes = 0
+    scan_flush_wall = 0.0
+
+    def flush_writes(now_w):
+        nonlocal wbuf, wpend, write_flushes, write_flush_wall
+        if not wpend:
+            return
+        t0f = clock()
+        wk = np.zeros((wf, N_LIMBS), np.uint32)
+        wv = np.zeros((wf,), np.uint32)
+        ws = np.zeros((wf,), np.uint32)
+        for j, ri in enumerate(wpend):
+            kb = keys[ri].tobytes()
+            wk[j] = keys[ri]
+            wv[j] = (ri + 1) & 0x7FFFFFFF
+            ws[j] = 2 + write_seq.get(kb, 0)
+            write_seq[kb] = write_seq.get(kb, 0) + 1
+        soak.store, _reps, _tr = _storage._announce_insert(
+            soak.swarm.alive, cfg, soak.store, soak.scfg, wbuf,
+            jnp.asarray(wk), jnp.asarray(wv), jnp.asarray(ws),
+            dev_u32(soak.store_now))
+        soak.store_now += 1
+        wbuf = jnp.full((wf, cfg.quorum), -1, jnp.int32)
+        wpend = []
+        write_flushes += 1
+        wall = clock() - t0f
+        write_flush_wall += wall
+        if timeline is not None:
+            timeline.note_op("write-flush", now_w, wall, maint=False)
+
+    def flush_scans():
+        nonlocal scan_queue, scan_done, scan_entries, scan_flushes, \
+            scan_flush_wall
+        if not scan_queue:
+            return
+        take, scan_queue = scan_queue, []
+        t0f = clock()
+        lo = soak.index.linearize(
+            [soak.scan_key_fn(int(scan_lo[ri])) for ri in take])
+        hi = soak.index.linearize(
+            [soak.scan_key_fn(int(scan_hi[ri])) for ri in take])
+        res, _leaves = soak.index.range_query(lo, hi)
+        t1f = clock()
+        for j, ri in enumerate(take):
+            lat = max(0.0, (t1f - t0) - float(arrival_ts[ri]))
+            scan_lat.append(lat)
+            scan_entries += len(res[j])
+            if latency_plane is not None:
+                latency_plane.observe(lat, op="scan")
+            if timeline is not None:
+                timeline.note_complete("scan", lat, t1f - t0)
+        scan_done += len(take)
+        scan_flushes += 1
+        scan_flush_wall += t1f - t0f
+
+    t0 = clock()
+    while True:
+        now = clock() - t0
+        # --- scenario events (strictly by wall time)
+        while ev_i < len(events) and events[ev_i].t <= now:
+            ev = events[ev_i]
+            ev_i += 1
+            t_ev = clock()
+            _apply_event(soak, ev, ev_i)
+            if timeline is not None:
+                timeline.note_op(f"scenario-{ev.kind}", now,
+                                 clock() - t_ev, maint=False)
+
+        while next_ev < r_total and arrival_ts[next_ev] <= now:
+            if ops[next_ev] == "scan" and do_scan:
+                scan_queue.append(next_ev)
+                if timeline is not None:
+                    timeline.note_arrival("scan", now)
+            else:
+                queue.append(next_ev)
+                if timeline is not None:
+                    timeline.note_arrival(
+                        "write" if ops[next_ev] == "write" else "read",
+                        now)
+            next_ev += 1
+        if len(queue) > overload:
+            raise ServeOverloadError(
+                f"serve overload: admission queue reached {len(queue)} "
+                f"requests (> {overload_queue_factor} x {c} slots) at "
+                f"t={now:.2f}s — the arrival rate exceeds what this "
+                f"slot capacity sustains on this machine; lower "
+                f"--arrival-rate or raise --serve-slots")
+        in_drain = next_ev >= r_total and not queue
+        if now > hard_wall and not (in_drain and (do_maint or do_mon)):
+            # The hard wall bounds the SCHEDULE phase.  Once arrivals
+            # are served and only maintenance is draining, the drain
+            # round cap governs termination instead — a 1M-node sweep
+            # legitimately drains longer than a small serve horizon,
+            # and that is backlog, not overload.  (Maintenance-off
+            # keeps the serve loop's unconditional wall: bit-identity.)
+            raise ServeOverloadError(
+                f"serve overload: run exceeded the {hard_wall:.0f}s "
+                f"hard wall ({r_total - next_ev + len(queue)} requests "
+                f"not yet admitted, {len(occupied)} in flight) — the "
+                f"arrival rate exceeds serve capacity on this machine")
+        queue_depths.append(len(queue))
+        if timeline is not None:
+            timeline.note_queue(len(queue), now)
+
+        # --- serve admission (strictly first; the serve loop verbatim)
+        m = min(len(queue), len(free), a_cap)
+        if m:
+            take = queue[:m]
+            del queue[:m]
+            slots_np = np.full(a_cap, c, np.int32)
+            keys_np = np.zeros((a_cap, N_LIMBS), np.uint32)
+            cls_np = np.zeros(a_cap, np.int32)
+            for j, ri in enumerate(take):
+                slot = free.pop()
+                slots_np[j] = slot
+                wcls = WC_WRITE if ops[ri] == "write" else WC_READ
+                cls_np[j] = wcls
+                occupied[slot] = (wcls, ri)
+                admit_wall[ri] = now
+                adm_c[wcls] += 1
+            keys_np[:m] = keys[np.asarray(take)]
+            st = soak.admit_serve(st, jnp.asarray(keys_np),
+                                  jnp.asarray(slots_np), cls_np,
+                                  jax.random.fold_in(key, adm_i), rnd)
+            adm_i += 1
+            admitted += m
+            if timeline is not None:
+                timeline.note_admit(
+                    {"read": int(np.sum(cls_np[:m] == WC_READ)),
+                     "write": int(np.sum(cls_np[:m] == WC_WRITE))},
+                    now)
+
+        sched_done = next_ev >= r_total and not queue
+
+        # --- maintenance cadence: arm new sweeps (never once the
+        # schedule drained — in-flight sweeps still finish below)
+        if do_mon and mon_sweep is None and not sched_done \
+                and now >= next_mon_t:
+            mon_sweep = soak.begin_monitor_sweep(st, now)
+        if do_maint and repub_sweep is None and not sched_done \
+                and now >= next_repub_t:
+            repub_sweep = soak.begin_repub_sweep(st, now)
+            if repub_sweep is None:       # empty store — re-arm later
+                next_repub_t = now + scfg_soak.repub_period_s
+        if next_listen_t is not None and now >= next_listen_t:
+            rec = soak.run_store_sweeps(now, clock)
+            next_listen_t = now + scfg_soak.listen_period_s
+            if timeline is not None:
+                timeline.note_op(rec["op"], now, rec["wall_s"])
+
+        # --- maintenance admission into LEFTOVER free slots (monitor
+        # probes first: detection lag is the bounded quantity), with
+        # the occupancy ceiling: maintenance never holds more than
+        # maint_slot_frac of the slot plane at once
+        maint_budget = min(
+            len(free), scfg_soak.maint_cap,
+            max(0, int(scfg_soak.maint_slot_frac * c)
+                - maint_occupied))
+        for sw in (mon_sweep, repub_sweep):
+            # Up to maint_cap rows per iteration, admitted in admit-cap
+            # chunks (the compiled admission width): one chunk per
+            # iteration would starve a wide slot plane — at 1M nodes a
+            # sweep feeds thousands of recycled slots per harvest.
+            while sw is not None and maint_budget > 0 \
+                    and sw.cursor < sw.total:
+                take_n = min(maint_budget, sw.total - sw.cursor,
+                             a_cap)
+                slots_np = np.full(a_cap, c, np.int32)
+                idx_np = np.full(a_cap, -1, np.int32)
+                for j in range(take_n):
+                    slot = free.pop()
+                    slots_np[j] = slot
+                    occupied[slot] = (sw.cls, (sw, sw.cursor))
+                    idx_np[j] = sw.cursor
+                    sw.cursor += 1
+                st = soak.admit_maintenance(st, sw, idx_np, slots_np,
+                                            rnd)
+                sw.admitted += take_n
+                adm_c[sw.cls] += take_n
+                maint_budget -= take_n
+                maint_occupied += take_n
+                if timeline is not None:
+                    timeline.note_admit(
+                        {WORK_CLASS_NAMES[sw.cls]: take_n}, now)
+
+        # --- scan station (batched, between bursts)
+        if do_scan and scan_queue and (
+                len(scan_queue) >= scfg_soak.scan_batch or sched_done
+                or now - float(arrival_ts[scan_queue[0]])
+                >= scfg_soak.scan_max_wait_s):
+            flush_scans()
+
+        draining = sched_done and not scan_queue
+        if draining and not occupied:
+            break
+        if not occupied and not queue:
+            if next_ev < r_total:
+                gap = arrival_ts[next_ev] - (clock() - t0)
+                if gap > 0:
+                    sleep(min(gap, 0.05))
+                continue
+            break
+
+        # --- burst + harvest (the one sync per iteration)
+        entry_occ = [0] * N_WORK_CLASSES
+        for (wcls, _ref) in occupied.values():
+            entry_occ[wcls] += 1
+        for _ in range(burst):
+            st = engine.step(st, rnd)
+            rnd += 1
+        done, hops, adm_r, com_r, found, dev_active = soak.snapshot(st)
+        w = clock() - t0
+        marks_r.append(rnd)
+        marks_w.append(w)
+        occ_samples.append(len(occupied) / c)
+
+        # Slots retired this burst, per class (includes done-but-never-
+        # stamped rows booked expired): the conservation identity is
+        # entry_occ == retired_this_burst + device_active_after.
+        retired_b = [0] * N_WORK_CLASSES
+        fold_groups: dict = {}
+        for slot in [s for s, _ in occupied.items() if done[s]]:
+            wcls, ref = occupied.pop(slot)
+            free.append(slot)
+            retired_b[wcls] += 1
+            if wcls in MAINT_CLASSES:
+                maint_occupied -= 1
+            cr = int(com_r[slot])
+            if wcls in SERVE_CLASSES:
+                ri = ref
+                if cr < 0:
+                    # Done with no completion stamp = forced retirement
+                    # — booked expired, never a latency sample.
+                    expired += 1
+                    exp_c[wcls] += 1
+                    if timeline is not None:
+                        timeline.note_expire(WORK_CLASS_NAMES[wcls], w)
+                    continue
+                cw = float(np.interp(cr + 1, marks_r[-2:],
+                                     marks_w[-2:]))
+                cw = max(cw, admit_wall[ri])
+                lat = cw - float(arrival_ts[ri])
+                rec_req.append(ri)
+                rec_lat.append(lat)
+                rec_hops.append(int(hops[slot]))
+                rec_rounds.append(cr - int(adm_r[slot]) + 1)
+                rec_found.append(int(found[slot, 0]) >= 0)
+                completed += 1
+                com_c[wcls] += 1
+                if wcls == WC_WRITE:
+                    fold_groups.setdefault("write", []).append(
+                        (slot, ri))
+                if latency_plane is not None:
+                    latency_plane.observe(
+                        lat, op=WORK_CLASS_NAMES[wcls])
+                if timeline is not None:
+                    timeline.note_complete(WORK_CLASS_NAMES[wcls],
+                                           lat, w)
+            else:
+                sw, pos = ref
+                if cr < 0:
+                    # Forced retirement without a completion stamp —
+                    # the probe/row never resolved: book it expired so
+                    # it is neither folded nor inserted, and (monitor)
+                    # its bucket is never marked probed — an expired
+                    # probe must not strike the nodes it never
+                    # reached.
+                    sw.expired += 1
+                    exp_c[wcls] += 1
+                    if timeline is not None:
+                        timeline.note_expire(WORK_CLASS_NAMES[wcls],
+                                             w)
+                    continue
+                sw.completed += 1
+                sw.done_rows.append(pos)
+                sw.hops.append(int(hops[slot]))
+                com_c[wcls] += 1
+                fold_groups.setdefault(sw, []).append((slot, pos))
+                if timeline is not None:
+                    timeline.note_complete(WORK_CLASS_NAMES[wcls],
+                                           None, w)
+
+        # Device-vs-host occupancy cross-check: after popping done
+        # slots, the host's per-class occupancy must equal the plane's
+        # active counts — the work-class plane's integrity gate.
+        post_occ = [0] * N_WORK_CLASSES
+        for (wcls, _ref) in occupied.values():
+            post_occ[wcls] += 1
+        if any(post_occ[x] != int(dev_active[x])
+               for x in range(N_WORK_CLASSES)):
+            wclass_mismatches += 1
+
+        # --- interleaved sweep folds (device-side, before the slots
+        # recycle into new admissions; chunked at the admit width —
+        # one burst can retire far more than a_cap slots)
+        for gkey, pairs in fold_groups.items():
+            for lo in range(0, len(pairs), a_cap):
+                chunk = pairs[lo:lo + a_cap]
+                sl_np = np.zeros(a_cap, np.int32)
+                if gkey == "write":
+                    if len(wpend) + len(chunk) > wf:
+                        # Flush BEFORE the buffer would overflow: a
+                        # fold position past wf is a silent drop.
+                        flush_writes(w)
+                    pos_np = np.full(a_cap, wf, np.int32)
+                    for j, (slot, ri) in enumerate(chunk):
+                        sl_np[j] = slot
+                        pos_np[j] = len(wpend)
+                        wpend.append(ri)
+                    wbuf = _fold_completed(
+                        wbuf, soak.swarm.ids, st, cfg,
+                        jnp.asarray(sl_np), jnp.asarray(pos_np))
+                else:
+                    sw = gkey
+                    pos_np = np.full(a_cap, sw.total, np.int32)
+                    for j, (slot, pos) in enumerate(chunk):
+                        sl_np[j] = slot
+                        pos_np[j] = pos
+                    if sw.cls == WC_REPUB:
+                        soak.insert_completed(sw, st, sl_np, pos_np)
+                    else:
+                        soak.fold_completed(sw, st, sl_np, pos_np)
+
+        # --- expiry: rows past their round budget retire (identical
+        # policy; per-class bookkeeping)
+        stale = [s for s in occupied
+                 if not done[s] and rnd - int(adm_r[s]) >= cfg.max_steps]
+        if stale:
+            batch = stale[:a_cap]
+            sl = np.full(a_cap, c, np.int32)
+            sl[:len(batch)] = batch
+            st = engine.expire(st, jnp.asarray(sl))
+            for slot in batch:
+                wcls, ref = occupied.pop(slot)
+                free.append(slot)
+                exp_c[wcls] += 1
+                if wcls in SERVE_CLASSES:
+                    expired += 1
+                else:
+                    ref[0].expired += 1
+                    maint_occupied -= 1
+                if timeline is not None:
+                    timeline.note_expire(WORK_CLASS_NAMES[wcls], w)
+
+        # --- timeline burst + lifecycle-boundary bookkeeping
+        if timeline is not None:
+            life_occ = [0] * N_WORK_CLASSES
+            for (wcls, _ref) in occupied.values():
+                life_occ[wcls] += 1
+            timeline.note_burst(
+                burst, list(entry_occ), list(retired_b),
+                [int(dev_active[x]) for x in range(N_WORK_CLASSES)],
+                w)
+            timeline.note_lifecycle(
+                {WORK_CLASS_NAMES[x]: {
+                    "admitted": adm_c[x], "completed": com_c[x],
+                    "expired": exp_c[x], "in_flight": life_occ[x]}
+                 for x in range(N_WORK_CLASSES)}, w)
+
+        # --- sweep completion: close drained sweeps, re-arm cadence
+        if mon_sweep is not None and mon_sweep.drained:
+            mon_sweep_records.append(
+                soak.finish_monitor_sweep(mon_sweep, w))
+            if timeline is not None:
+                timeline.note_sweep("monitor", mon_sweep_records[-1],
+                                    w)
+            mon_sweep = None
+            next_mon_t = w + scfg_soak.monitor_gap_s
+        if repub_sweep is not None and repub_sweep.drained:
+            repub_done_records.append(
+                soak.finish_repub_sweep(repub_sweep, w))
+            if timeline is not None:
+                timeline.note_sweep("repub", repub_done_records[-1], w)
+            repub_sweep = None
+            next_repub_t = w + scfg_soak.repub_period_s
+
+        if draining:
+            drain_rounds += burst
+            if drain_rounds > drain_cap:
+                break
+
+    elapsed = clock() - t0
+    # Final flush + partial sweep closes (drain-cap leftovers fold
+    # with what completed; unadmitted rows were never dispatched, so
+    # every conservation identity holds).
+    if wpend:
+        flush_writes(elapsed)
+    if mon_sweep is not None and mon_sweep.admitted:
+        mon_sweep_records.append(
+            soak.finish_monitor_sweep(mon_sweep, elapsed))
+    if repub_sweep is not None and repub_sweep.admitted:
+        repub_done_records.append(
+            soak.finish_repub_sweep(repub_sweep, elapsed))
+    if timeline is not None:
+        timeline.close(elapsed)
+
+    in_flight_c = [0] * N_WORK_CLASSES
+    for (wcls, _ref) in occupied.values():
+        in_flight_c[wcls] += 1
+    serve_in_flight = sum(in_flight_c[x] for x in SERVE_CLASSES)
+    scan_arrived = scan_done + len(scan_queue)
+    return {
+        "slots": c,
+        "admit_cap": a_cap,
+        "burst": burst,
+        "admitted": admitted,
+        "completed": completed,
+        "expired": expired,
+        "in_flight": serve_in_flight,
+        # Slot-served never-admitted: queued + not-yet-arrived, minus
+        # the schedule's scan ops that the scan station owns.  With no
+        # scan station this is the serve loop's formula verbatim.
+        "never_admitted": len(queue) + (r_total - next_ev)
+        - (n_scan_sched - scan_arrived),
+        "rounds": rnd,
+        "elapsed_s": elapsed,
+        "sustained_rps": completed / elapsed if elapsed > 0 else 0.0,
+        "request": np.asarray(rec_req, np.int64),
+        "latency_s": np.asarray(rec_lat, np.float64),
+        "hops": np.asarray(rec_hops, np.int64),
+        "service_rounds": np.asarray(rec_rounds, np.int64),
+        "found_nonempty": np.asarray(rec_found, bool),
+        "klass": np.asarray(klass)[np.asarray(rec_req, np.int64)]
+        if completed else np.asarray([], dtype="<U4"),
+        "op": np.asarray(ops)[np.asarray(rec_req, np.int64)]
+        if completed else np.asarray([], dtype="<U5"),
+        "queue_depth_mean": float(np.mean(queue_depths))
+        if queue_depths else 0.0,
+        "queue_depth_max": int(np.max(queue_depths))
+        if queue_depths else 0,
+        "slot_occupancy_frac": float(np.mean(occ_samples))
+        if occ_samples else 0.0,
+        "burst_marks": list(zip(marks_r, marks_w)),
+        # --- soak superset ---
+        "maintenance": bool(do_maint or do_mon),
+        "lifecycle_by_class": {
+            WORK_CLASS_NAMES[x]: {
+                "admitted": adm_c[x], "completed": com_c[x],
+                "expired": exp_c[x], "in_flight": in_flight_c[x]}
+            for x in range(N_WORK_CLASSES)},
+        "wclass_mismatches": wclass_mismatches,
+        "repub_sweeps": repub_done_records,
+        "monitor_sweeps": mon_sweep_records,
+        "maint_ops": soak.maint_ops,
+        "write_flushes": write_flushes,
+        "write_flush_wall_s": round(write_flush_wall, 6),
+        "scan": {
+            "arrived": scan_arrived,
+            "completed": scan_done,
+            "pending": len(scan_queue),
+            "flushes": scan_flushes,
+            "flush_wall_s": round(scan_flush_wall, 6),
+            "entries_returned": scan_entries,
+            "latency_mean_s": round(float(np.mean(scan_lat)), 6)
+            if scan_lat else None,
+            "latency_max_s": round(float(np.max(scan_lat)), 6)
+            if scan_lat else None,
+        },
+    }

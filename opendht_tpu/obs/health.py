@@ -221,6 +221,50 @@ def poisson_density_profile(bucket_counts: Sequence[int],
     }
 
 
+def summarize_sweeps(records: Sequence[Dict[str, object]]
+                     ) -> Dict[str, object]:
+    """Steady-state summary of a monitor sweep-record list.
+
+    The one reduction of per-sweep records every consumer needs — the
+    monitor bench row, the soak artifact's monitor block, and
+    ``tools/check_trace.py``'s soak checker (which RECOMPUTES it from
+    the embedded records, so a summary diverging from its own sweeps
+    cannot gate green).  Steady state = post-initial sweeps (sweep 0 is
+    the full crawl); lag fields are ``None`` when no death was
+    confirmed.  Records without the freshness plane (``coverage``
+    absent) summarize to counts only.
+    """
+    recs = list(records)
+    if not recs:
+        raise ValueError("no sweep records to summarize")
+    out: Dict[str, object] = {
+        "sweeps": len(recs),
+        "lookups_total": int(sum(r["lookups"] for r in recs)),
+    }
+    if "coverage" not in recs[0]:
+        return out
+    post = recs[1:] or recs
+    lag_cnt = int(sum(r["lag_count"] for r in recs))
+    out.update({
+        "coverage_mean": round(
+            float(np.mean([r["coverage"] for r in post])), 6),
+        "coverage_min": round(min(r["coverage"] for r in post), 6),
+        "coverage_final": recs[-1]["coverage"],
+        "deaths_detected": lag_cnt,
+        "detection_lag_mean": (round(
+            sum(r["lag_sum"] for r in recs) / lag_cnt, 3)
+            if lag_cnt else None),
+        "detection_lag_max": (max(
+            r["lag_max"] for r in recs if r["lag_count"])
+            if lag_cnt else None),
+        "false_dead_final": recs[-1]["false_dead"],
+        "false_alive_final": recs[-1]["false_alive"],
+        "freshness_p50_final": recs[-1]["age_p50"],
+        "freshness_p99_final": recs[-1]["age_p99"],
+    })
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the gauge surface
 # ---------------------------------------------------------------------------

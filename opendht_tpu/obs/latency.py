@@ -77,6 +77,8 @@ class LatencyPlane:
         self._lock = threading.Lock()
         self._n = 0
         self._over = 0
+        self._win_n = 0
+        self._win_over = 0
 
     def observe(self, seconds: float, **labels) -> None:
         """Record one request and refresh the SLO gauges."""
@@ -85,11 +87,28 @@ class LatencyPlane:
         self.hist.observe(seconds, **labels)
         with self._lock:
             self._n += 1
+            self._win_n += 1
             if seconds > self.slo_target_s:
                 self._over += 1
+                self._win_over += 1
             ratio = self._over / self._n
         self._violation.set(ratio)
         self._burn.set(ratio / (1.0 - self.slo_objective))
+
+    def take_window(self) -> tuple[int, int]:
+        """Drain the windowed counters: ``(observations, violations)``
+        since the previous ``take_window`` call.  The short-window half
+        of the SRE multiwindow burn-rate rule for LIVE consumers (a
+        scrape loop calling this per scrape gets per-window violation
+        ratios next to the cumulative gauges).  The soak bench derives
+        its per-interval ratios from the timeline's own counts instead
+        — this API is for the long-running-node surfaces (gateway,
+        daemons) where no timeline exists."""
+        with self._lock:
+            n, over = self._win_n, self._win_over
+            self._win_n = 0
+            self._win_over = 0
+        return n, over
 
     @property
     def violation_ratio(self) -> float:

@@ -86,6 +86,11 @@ ENTRY_POINTS: tuple = (
     ("opendht_tpu.models.serve", "_scatter_admission", (0,)),
     ("opendht_tpu.models.serve", "_snapshot", ()),
     ("opendht_tpu.models.serve", "_expire_slots", (0,)),
+    ("opendht_tpu.models.soak", "_scatter_wclass", (0,)),
+    ("opendht_tpu.models.soak", "_admit_maintenance", (2, 3)),
+    ("opendht_tpu.models.soak", "_fold_completed", (0,)),
+    ("opendht_tpu.models.soak", "_repub_insert_completed", (4, 15)),
+    ("opendht_tpu.models.soak", "_soak_snapshot", ()),
     ("opendht_tpu.models.storage", "_store_insert", (0,)),
     ("opendht_tpu.models.storage", "_announce_insert", (2,)),
     ("opendht_tpu.models.storage", "_get_probe", ()),

@@ -57,7 +57,8 @@ def _load_row(path: str) -> dict:
     with open(path) as f:
         obj = json.load(f)
     if obj.get("kind") in ("swarm_lookup_trace", "swarm_serve_trace",
-                           "swarm_monitor_trace", "swarm_index_trace"):
+                           "swarm_monitor_trace", "swarm_index_trace",
+                           "swarm_soak_trace"):
         obj = obj["bench"]                           # ...artifacts
     if "value" not in obj or "metric" not in obj:
         raise ValueError(f"{path}: no BENCH row found (need "
@@ -124,6 +125,50 @@ def check_bench_rows(cur: dict, base: dict,
         print(f"check_bench: rate comparison SKIPPED — platform "
               f"{cur.get('platform')!r} vs baseline "
               f"{base.get('platform')!r} (quality gates still apply)")
+
+    # Soak rows (swarm_soak_req_per_sec): the rate floor and p99
+    # ceiling above already apply; these are the any-platform QUALITY
+    # gates — an always-on node that serves fast by dropping its
+    # maintenance duties must never gate green.
+    if cur.get("metric") == "swarm_soak_req_per_sec":
+        if cur.get("wclass_mismatches") != 0:
+            errs.append(f"wclass_mismatches "
+                        f"{cur.get('wclass_mismatches')!r} != 0 — "
+                        f"the work-class plane lost integrity")
+        sv, sv_max = cur.get("slo_violation_ratio"), cur.get(
+            "slo_violation_max")
+        if sv is not None and sv_max is not None and sv > sv_max:
+            errs.append(f"slo_violation_ratio {sv} above the stated "
+                        f"bound {sv_max}")
+        lag, lag_bound = cur.get("detection_lag_max"), base.get(
+            "detection_lag_bound_sweeps")
+        if lag is not None and lag_bound is not None \
+                and lag > lag_bound:
+            errs.append(f"detection_lag_max {lag} exceeds the "
+                        f"recorded sweep-period bound {lag_bound}")
+        cov, cov_b = cur.get("monitor_coverage"), base.get(
+            "monitor_coverage")
+        if cov is not None and cov_b is not None \
+                and cov < COVERAGE_MIN_RATIO * cov_b:
+            errs.append(f"monitor_coverage {cov} below "
+                        f"{COVERAGE_MIN_RATIO:.0%} of recorded "
+                        f"{cov_b}")
+        surv, surv_b = cur.get("value_survival_final"), base.get(
+            "value_survival_final")
+        if surv is not None and surv_b is not None \
+                and surv < COVERAGE_MIN_RATIO * surv_b:
+            errs.append(f"value_survival_final {surv} below "
+                        f"{COVERAGE_MIN_RATIO:.0%} of recorded "
+                        f"{surv_b} — re-replication regressed")
+        rs, rs_b = cur.get("repub_sweeps"), base.get("repub_sweeps")
+        ms, ms_b = cur.get("monitor_sweeps"), base.get(
+            "monitor_sweeps")
+        if rs is not None and rs_b and rs < 1:
+            errs.append("no republish sweep completed (baseline "
+                        f"recorded {rs_b})")
+        if ms is not None and ms_b and ms < 1:
+            errs.append("no monitor sweep completed (baseline "
+                        f"recorded {ms_b})")
 
     # Index rows (swarm_index_scan_entries_per_sec): exactness is a
     # hard quality gate on ANY platform — a scan that got faster by

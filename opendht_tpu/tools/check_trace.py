@@ -431,6 +431,62 @@ def check_serve_obj(obj: dict) -> List[str]:
 MONITOR_MAX_BAND_TV = 0.25
 
 
+def _check_sweep_conservation(sweeps, bound, errs: List[str]) -> None:
+    """The monitor fold's EXACT per-sweep identities, shared by the
+    monitor checker and the soak checker (soak sweeps come from the
+    same ``fold_sweep`` program, interleaved instead of closed-loop):
+    freshness conservation, probe accounting, fresh⇔seen, coverage
+    arithmetic, and detection lag within ``bound``."""
+    count_fields = ("nodes_seen", "newly_discovered", "resurrected",
+                    "newly_dead", "tracked_alive", "covered",
+                    "actual_alive", "false_alive", "false_dead",
+                    "probed_tracked", "probed_seen", "probed_missed",
+                    "lag_sum", "lag_count", "nodes_fresh")
+    prev_alive = 0
+    for r in sweeps:
+        s = r.get("sweep", "?")
+        missing = [f for f in count_fields
+                   if not (_num(r.get(f)) and r[f] >= 0)]
+        if missing:
+            errs.append(f"sweep {s}: missing/negative counters "
+                        f"{missing}")
+            return
+        # (a) freshness conservation — exact identities of the fold.
+        want = (prev_alive + r["newly_discovered"] + r["resurrected"]
+                - r["newly_dead"])
+        if r["tracked_alive"] != want:
+            errs.append(
+                f"sweep {s}: tracked_alive {r['tracked_alive']} != "
+                f"prev + discovered + resurrected - dead = {want} "
+                f"(freshness does not conserve)")
+        if r["probed_tracked"] != r["probed_seen"] + r["probed_missed"]:
+            errs.append(
+                f"sweep {s}: probed_tracked {r['probed_tracked']} != "
+                f"probed_seen {r['probed_seen']} + probed_missed "
+                f"{r['probed_missed']}")
+        if r["nodes_fresh"] != r["nodes_seen"]:
+            errs.append(f"sweep {s}: nodes_fresh {r['nodes_fresh']} != "
+                        f"nodes_seen {r['nodes_seen']} — a node must "
+                        f"be fresh iff this sweep saw it")
+        if r["covered"] > min(r["tracked_alive"], r["actual_alive"]):
+            errs.append(f"sweep {s}: covered {r['covered']} exceeds "
+                        f"tracked/actual population")
+        cov = r.get("coverage")
+        want_cov = r["covered"] / max(1, r["actual_alive"])
+        if not (_num(cov) and abs(cov - want_cov) <= 1e-5):
+            errs.append(f"sweep {s}: coverage {cov!r} != covered/"
+                        f"actual_alive {want_cov:.6f}")
+        if r["lag_count"] > r["newly_dead"]:
+            errs.append(f"sweep {s}: lag_count {r['lag_count']} > "
+                        f"newly_dead {r['newly_dead']}")
+        if r["lag_count"] and not (_num(r.get("lag_max"))
+                                   and 0 <= r["lag_max"] <= bound):
+            errs.append(f"sweep {s}: lag_max {r.get('lag_max')!r} "
+                        f"outside [0, {bound}] — detection slower "
+                        f"than the stated sweep period")
+        prev_alive = r["tracked_alive"]
+
+
 def check_monitor_obj(obj: dict) -> List[str]:
     """All violations found in a loaded swarm-monitor artifact (empty
     = pass).
@@ -481,54 +537,13 @@ def check_monitor_obj(obj: dict) -> List[str]:
         errs.append(f"detection_lag_bound_sweeps {bound} != period + "
                     f"miss_limit - 1 = {want_bound}")
 
-    count_fields = ("nodes_seen", "newly_discovered", "resurrected",
-                    "newly_dead", "tracked_alive", "covered",
-                    "actual_alive", "false_alive", "false_dead",
-                    "probed_tracked", "probed_seen", "probed_missed",
-                    "lag_sum", "lag_count", "nodes_fresh")
-    prev_alive = 0
-    for r in sweeps:
-        s = r.get("sweep", "?")
-        missing = [f for f in count_fields
-                   if not (_num(r.get(f)) and r[f] >= 0)]
-        if missing:
-            errs.append(f"sweep {s}: missing/negative counters "
-                        f"{missing}")
-            return errs
-        # (a) freshness conservation — exact identities of the fold.
-        want = (prev_alive + r["newly_discovered"] + r["resurrected"]
-                - r["newly_dead"])
-        if r["tracked_alive"] != want:
-            errs.append(
-                f"sweep {s}: tracked_alive {r['tracked_alive']} != "
-                f"prev + discovered + resurrected - dead = {want} "
-                f"(freshness does not conserve)")
-        if r["probed_tracked"] != r["probed_seen"] + r["probed_missed"]:
-            errs.append(
-                f"sweep {s}: probed_tracked {r['probed_tracked']} != "
-                f"probed_seen {r['probed_seen']} + probed_missed "
-                f"{r['probed_missed']}")
-        if r["nodes_fresh"] != r["nodes_seen"]:
-            errs.append(f"sweep {s}: nodes_fresh {r['nodes_fresh']} != "
-                        f"nodes_seen {r['nodes_seen']} — a node must "
-                        f"be fresh iff this sweep saw it")
-        if r["covered"] > min(r["tracked_alive"], r["actual_alive"]):
-            errs.append(f"sweep {s}: covered {r['covered']} exceeds "
-                        f"tracked/actual population")
-        cov = r.get("coverage")
-        want_cov = r["covered"] / max(1, r["actual_alive"])
-        if not (_num(cov) and abs(cov - want_cov) <= 1e-5):
-            errs.append(f"sweep {s}: coverage {cov!r} != covered/"
-                        f"actual_alive {want_cov:.6f}")
-        if r["lag_count"] > r["newly_dead"]:
-            errs.append(f"sweep {s}: lag_count {r['lag_count']} > "
-                        f"newly_dead {r['newly_dead']}")
-        if r["lag_count"] and not (_num(r.get("lag_max"))
-                                   and 0 <= r["lag_max"] <= bound):
-            errs.append(f"sweep {s}: lag_max {r.get('lag_max')!r} "
-                        f"outside [0, {bound}] — detection slower "
-                        f"than the stated sweep period")
-        prev_alive = r["tracked_alive"]
+    n_before = len(errs)
+    _check_sweep_conservation(sweeps, bound, errs)
+    if any("missing/negative counters" in e for e in errs[n_before:]):
+        # Malformed records can't be read further; every OTHER
+        # conservation violation still lets the hop-fidelity and
+        # bench-row checks below run and report alongside it.
+        return errs
 
     # (c) hop-histogram-vs-analytic-model fidelity, recomputed.
     hist = mon.get("hop_histogram_initial")
@@ -688,6 +703,405 @@ def check_index_obj(obj: dict) -> List[str]:
     return errs
 
 
+# Soak-artifact contract ceilings: the artifact STATES its SLO
+# violation bound and value-survival floor (knobs of the run), but a
+# bound loose enough to gate nothing must itself fail.  The survival
+# floor is SCENARIO-derived: a contiguous keyspace outage of fraction
+# f kills every replica of the keys wholly inside it at once — no
+# republish can recover data that no longer exists anywhere — so the
+# tightest honest floor is ~(1 - f); the checker requires the stated
+# floor to be at least ``1 - 2f - 0.005`` (and never below
+# SOAK_SURVIVAL_FLOOR_ABS), recomputed from the bench row's own
+# outage_frac so a run cannot loosen its floor beyond what its
+# scenario justifies.
+SOAK_MAX_SLO_BOUND = 0.25
+SOAK_SURVIVAL_FLOOR_ABS = 0.90
+_SOAK_CLASSES = ("read", "write", "repub", "monitor")
+_SOAK_SERVE = ("read", "write")
+
+
+def _soak_life_ok(d: dict) -> bool:
+    return all(_num(d.get(f)) and d[f] >= 0 for f in
+               ("admitted", "completed", "expired", "in_flight"))
+
+
+def check_soak_obj(obj: dict) -> List[str]:
+    """All violations found in a loaded ``swarm_soak_trace`` artifact
+    (empty = pass).  The soak gate's contract (ISSUE 11):
+
+    a. **lifecycle conservation, per work class** — ``admitted ==
+       completed + expired + in_flight`` for read/write/repub/monitor,
+       at the run level AND at every timeline interval boundary; the
+       scan station conserves ``arrived == completed + pending``; the
+       device work-class plane never disagreed with the host slot
+       bookkeeping (``wclass_mismatches == 0``);
+    b. **slot-round split** — per interval, serve + maintenance
+       slot-rounds (device-plane testimony) must equal total
+       dispatched slot-rounds (host bookkeeping) exactly;
+    c. **latency integrity** — each interval's completions equal its
+       histogram count, every derived quantile (per-interval and
+       overall) sits inside the bucket holding it, the interval
+       histograms sum to the run histogram, and the run histogram
+       holds exactly ``completed`` observations;
+    d. **monitor plane** — the interleaved sweeps satisfy the same
+       exact freshness-conservation identities as ``--mode monitor``
+       (shared checker), detection lag sits within the config-derived
+       scheduler bound, and the embedded summary matches the records;
+    e. **re-replication** — final value survival on the tracked keyset
+       meets the stated floor (itself capped ≥
+       :data:`SOAK_MIN_SURVIVAL_FLOOR`), with at least one republish
+       sweep completed;
+    f. **SLO** — the measured violation ratio sits within the stated
+       bound (capped at :data:`SOAK_MAX_SLO_BOUND`);
+    g. **interference ledger** — when present, the A/B arms align on
+       interval width, the overall p99s are reproducible from the two
+       embedded timelines, and the attributed delta equals their
+       difference (a fabricated interference number is rejected).
+    """
+    from ..utils.metrics import Histogram
+
+    errs: List[str] = []
+    for field in ("kind", "bench", "lifecycle", "timeline",
+                  "latency_histogram", "latency_quantiles_s"):
+        if field not in obj:
+            errs.append(f"missing top-level field {field!r}")
+    if errs:
+        return errs
+    bench, life = obj["bench"], obj["lifecycle"]
+    tl, hist = obj["timeline"], obj["latency_histogram"]
+    quants = obj["latency_quantiles_s"]
+
+    # (a) run-level lifecycle, per class ------------------------------
+    by_cls = life.get("by_class") or {}
+    for cls in _SOAK_CLASSES:
+        d = by_cls.get(cls)
+        if not (isinstance(d, dict) and _soak_life_ok(d)):
+            errs.append(f"lifecycle class {cls!r} missing/invalid: "
+                        f"{d!r}")
+            continue
+        if d["admitted"] != d["completed"] + d["expired"] \
+                + d["in_flight"]:
+            errs.append(
+                f"lifecycle [{cls}] does not conserve: admitted "
+                f"{d['admitted']} != completed {d['completed']} + "
+                f"expired {d['expired']} + in_flight {d['in_flight']}")
+    if errs:
+        return errs
+    serve_adm = sum(by_cls[c]["admitted"] for c in _SOAK_SERVE)
+    serve_com = sum(by_cls[c]["completed"] for c in _SOAK_SERVE)
+    if life.get("admitted") != serve_adm:
+        errs.append(f"lifecycle admitted {life.get('admitted')} != "
+                    f"serve-class sum {serve_adm}")
+    if life.get("completed") != serve_com:
+        errs.append(f"lifecycle completed {life.get('completed')} != "
+                    f"serve-class sum {serve_com}")
+    if serve_com == 0:
+        errs.append("no serve request completed — nothing to stand "
+                    "behind")
+    wmm = life.get("wclass_mismatches")
+    if wmm != 0:
+        errs.append(f"wclass_mismatches {wmm!r} != 0 — the device "
+                    f"work-class plane disagreed with the host slot "
+                    f"bookkeeping")
+    scan = life.get("scan") or {}
+    if scan and scan.get("arrived") != scan.get("completed", 0) \
+            + scan.get("pending", 0):
+        errs.append(f"scan station does not conserve: arrived "
+                    f"{scan.get('arrived')} != completed "
+                    f"{scan.get('completed')} + pending "
+                    f"{scan.get('pending')}")
+
+    # (b)+(c) the timeline rows ---------------------------------------
+    bounds = tl.get("latency_bounds_s") or []
+    rows = tl.get("rows") or []
+    if not rows:
+        errs.append("timeline has no rows")
+        return errs
+    if not (_num(tl.get("interval_s")) and tl["interval_s"] > 0):
+        errs.append(f"timeline interval_s invalid: "
+                    f"{tl.get('interval_s')!r}")
+        return errs
+    if any(b <= 0 for b in bounds) or \
+            any(x >= y for x, y in zip(bounds, bounds[1:])):
+        errs.append("timeline latency bounds not positive-increasing")
+        return errs
+    sum_counts = [0] * (len(bounds) + 1)
+    sum_viol = 0
+    prev_life = None
+    last_life = None
+    for r in rows:
+        i = r.get("i", "?")
+        sr = r.get("slot_rounds") or {}
+        split = sum(int(sr.get(w, 0)) for w in _SOAK_CLASSES)
+        if r.get("total_slot_rounds") != split:
+            errs.append(
+                f"interval {i}: serve+maintenance slot-rounds {split} "
+                f"!= total dispatched {r.get('total_slot_rounds')} — "
+                f"the device plane and host bookkeeping disagree")
+        counts = r.get("latency_counts") or []
+        n_lat = int(sum(counts))
+        if r.get("latency_count") != n_lat:
+            errs.append(f"interval {i}: latency_count "
+                        f"{r.get('latency_count')} != counts sum "
+                        f"{n_lat}")
+        comp = r.get("completed") or {}
+        serve_done = sum(int(comp.get(w, 0)) for w in _SOAK_SERVE)
+        if serve_done != n_lat:
+            errs.append(f"interval {i}: serve completions "
+                        f"{serve_done} != latency observations "
+                        f"{n_lat}")
+        if len(counts) == len(bounds) + 1:
+            for j, v in enumerate(counts):
+                sum_counts[j] += int(v)
+        else:
+            errs.append(f"interval {i}: latency_counts has "
+                        f"{len(counts)} bins for {len(bounds)} bounds")
+        viol = r.get("slo_violations", 0)
+        if not (_num(viol) and 0 <= viol <= n_lat):
+            errs.append(f"interval {i}: slo_violations {viol!r} "
+                        f"outside [0, {n_lat}]")
+        else:
+            sum_viol += int(viol)
+        if n_lat:
+            h = Histogram("soak_check_iv", "", buckets=bounds)
+            h.observe_bulk(counts, 0.0)
+            for nm, q in (("latency_p50_s", 0.50),
+                          ("latency_p99_s", 0.99)):
+                v = r.get(nm)
+                if not _num(v):
+                    errs.append(f"interval {i}: {nm} {v!r} with "
+                                f"{n_lat} observations")
+                    continue
+                lo, hi = h.bucket_bounds_of_quantile(q)
+                if not (lo - 1e-9 <= v <= hi + 1e-9):
+                    errs.append(f"interval {i}: {nm} {v:.6f}s outside "
+                                f"its histogram bucket ({lo:.6f}, "
+                                f"{hi:.6f}]")
+        lf = r.get("lifecycle")
+        if lf is not None:
+            for cls in _SOAK_CLASSES:
+                d = lf.get(cls)
+                if not (isinstance(d, dict) and _soak_life_ok(d)):
+                    errs.append(f"interval {i}: lifecycle snapshot "
+                                f"class {cls!r} invalid")
+                    continue
+                if d["admitted"] != d["completed"] + d["expired"] \
+                        + d["in_flight"]:
+                    errs.append(
+                        f"interval {i} [{cls}]: boundary conservation "
+                        f"broken: admitted {d['admitted']} != "
+                        f"completed {d['completed']} + expired "
+                        f"{d['expired']} + in_flight {d['in_flight']}")
+                if prev_life is not None and cls in prev_life:
+                    for mono in ("admitted", "completed", "expired"):
+                        if d[mono] < prev_life[cls][mono]:
+                            errs.append(
+                                f"interval {i} [{cls}]: cumulative "
+                                f"{mono} decreased "
+                                f"({prev_life[cls][mono]} -> "
+                                f"{d[mono]})")
+            prev_life = lf
+            last_life = lf
+    if errs:
+        return errs
+    if sum_counts != [int(v) for v in (hist.get("counts") or [])]:
+        errs.append("run latency histogram != sum of interval "
+                    "histograms")
+    if sum(sum_counts) != serve_com:
+        errs.append(f"latency histogram holds {sum(sum_counts)} "
+                    f"observations but {serve_com} serve requests "
+                    f"completed")
+    if last_life is not None:
+        for cls in _SOAK_CLASSES:
+            if last_life[cls] != by_cls[cls]:
+                errs.append(
+                    f"final lifecycle [{cls}] {by_cls[cls]} != last "
+                    f"interval boundary snapshot {last_life[cls]}")
+
+    # (c) overall quantiles + bench-row copies ------------------------
+    hist_obj = None
+    if sum(sum_counts) > 0:
+        hist_obj = Histogram("soak_check_run", "", buckets=bounds)
+        hist_obj.observe_bulk(sum_counts, 0.0)
+    prev = -1.0
+    for name, q in SERVE_QUANTILES if serve_com else ():
+        v = quants.get(name)
+        if not (_num(v) and v >= 0):
+            errs.append(f"latency quantile {name} invalid: {v!r}")
+            continue
+        if v < prev - 1e-12:
+            errs.append(f"latency quantiles not monotone at {name}")
+        prev = v
+        if hist_obj is not None:
+            lo, hi = hist_obj.bucket_bounds_of_quantile(q)
+            if not (lo - 1e-9 <= v <= hi + 1e-9):
+                errs.append(f"latency {name} {v:.6f}s outside its "
+                            f"histogram bucket ({lo:.6f}, {hi:.6f}]")
+        row_v = bench.get(f"latency_{name}_s")
+        if row_v is not None and (not _num(row_v)
+                                  or abs(row_v - v) > 1e-6):
+            errs.append(f"bench latency_{name}_s {row_v!r} != "
+                        f"artifact quantile {v}")
+
+    # (f) SLO bound ---------------------------------------------------
+    ratio = bench.get("slo_violation_ratio")
+    bound_slo = bench.get("slo_violation_max")
+    if not (_num(bound_slo) and 0 < bound_slo <= SOAK_MAX_SLO_BOUND):
+        errs.append(f"slo_violation_max {bound_slo!r} missing or "
+                    f"above the {SOAK_MAX_SLO_BOUND} ceiling")
+    elif not (_num(ratio) and 0 <= ratio <= bound_slo):
+        errs.append(f"slo_violation_ratio {ratio!r} outside the "
+                    f"stated bound {bound_slo} — the SLO is burned")
+    want_ratio = round(sum_viol / sum(sum_counts), 6) \
+        if sum(sum_counts) else 0.0
+    if _num(ratio) and abs(ratio - want_ratio) > 1e-6:
+        errs.append(f"slo_violation_ratio {ratio} != interval "
+                    f"violations / completions {want_ratio}")
+
+    # (d) monitor plane ----------------------------------------------
+    mon = obj.get("monitor") or {}
+    sweeps = mon.get("sweeps") or []
+    if bench.get("monitor_sweeps"):
+        cfg = mon.get("config") or {}
+        for knob in ("period", "miss_limit",
+                     "detection_lag_bound_sweeps"):
+            if not (_num(cfg.get(knob)) and cfg[knob] >= 0):
+                errs.append(f"monitor config {knob} invalid: "
+                            f"{cfg.get(knob)!r}")
+                return errs
+        bound = cfg["detection_lag_bound_sweeps"]
+        if bound != cfg["period"] + cfg["miss_limit"] - 1:
+            errs.append(f"detection_lag_bound_sweeps {bound} != "
+                        f"period + miss_limit - 1")
+        if not sweeps:
+            errs.append("bench reports monitor sweeps but the "
+                        "monitor block has none")
+            return errs
+        _check_sweep_conservation(sweeps, bound, errs)
+        summary = mon.get("summary") or {}
+        from ..obs.health import summarize_sweeps
+        try:
+            re_sum = summarize_sweeps(sweeps)
+        except (KeyError, ValueError) as e:
+            errs.append(f"monitor summary not recomputable: {e}")
+            re_sum = None
+        if re_sum is not None:
+            for f in ("coverage_mean", "coverage_min",
+                      "deaths_detected", "detection_lag_max"):
+                if summary.get(f) != re_sum.get(f):
+                    errs.append(
+                        f"monitor summary {f} {summary.get(f)!r} != "
+                        f"recomputed {re_sum.get(f)!r}")
+            lag = re_sum.get("detection_lag_max")
+            if lag is not None and lag > bound:
+                errs.append(f"detection_lag_max {lag} exceeds the "
+                            f"scheduler bound {bound}")
+            if bench.get("detection_lag_max") != lag:
+                errs.append(
+                    f"bench detection_lag_max "
+                    f"{bench.get('detection_lag_max')!r} != summary "
+                    f"{lag!r}")
+
+    # (e) re-replication ----------------------------------------------
+    rep = obj.get("repub") or {}
+    if bench.get("repub_sweeps"):
+        floor = rep.get("survival_floor")
+        surv = rep.get("survival_final")
+        of = bench.get("outage_frac")
+        of = of if _num(of) and of >= 0 else 0.0
+        min_floor = max(SOAK_SURVIVAL_FLOOR_ABS,
+                        1.0 - 2.0 * of - 0.005)
+        if not (_num(floor) and min_floor <= floor <= 1.0):
+            errs.append(f"repub survival_floor {floor!r} missing or "
+                        f"below the scenario-derived minimum "
+                        f"{min_floor:.4f} (outage_frac {of})")
+        elif not (_num(surv) and surv >= floor):
+            errs.append(f"value survival {surv!r} below the stated "
+                        f"floor {floor} — re-replication did not "
+                        f"complete")
+        off_surv = rep.get("survival_off_arm")
+        if _num(surv) and _num(off_surv) \
+                and surv < off_surv - 0.005:
+            errs.append(f"value survival {surv} WORSE than the "
+                        f"maintenance-off arm {off_surv} — "
+                        f"re-replication is doing harm")
+        rsweeps = rep.get("sweeps") or []
+        if len(rsweeps) != bench["repub_sweeps"]:
+            errs.append(f"bench repub_sweeps {bench['repub_sweeps']} "
+                        f"!= {len(rsweeps)} recorded sweeps")
+        for k, sw in enumerate(rsweeps):
+            if sw.get("admitted") != sw.get("completed", 0) \
+                    + sw.get("expired", 0) + sw.get("in_flight", 0):
+                errs.append(f"repub sweep {k}: admitted "
+                            f"{sw.get('admitted')} != completed + "
+                            f"expired + in_flight")
+            if _num(sw.get("admitted")) and _num(sw.get("rows")) \
+                    and sw["admitted"] > sw["rows"]:
+                errs.append(f"repub sweep {k}: admitted "
+                            f"{sw['admitted']} > rows {sw['rows']}")
+        if bench.get("value_survival_final") != surv:
+            errs.append(f"bench value_survival_final "
+                        f"{bench.get('value_survival_final')!r} != "
+                        f"repub block {surv!r}")
+
+    # (g) interference ledger -----------------------------------------
+    led = obj.get("interference")
+    tl_off = obj.get("timeline_off")
+    if led is not None:
+        if tl_off is None:
+            errs.append("interference ledger without timeline_off — "
+                        "the A/B arm is missing")
+            return errs
+        if led.get("interval_s") != tl.get("interval_s") \
+                or tl_off.get("interval_s") != tl.get("interval_s"):
+            errs.append("interference/timeline interval widths "
+                        "disagree — the arms cannot align")
+        for side, tline in (("on", tl), ("off", tl_off)):
+            tot = [0] * (len(bounds) + 1)
+            for r in tline.get("rows") or []:
+                cc = r.get("latency_counts") or []
+                if len(cc) == len(bounds) + 1:
+                    for j, v in enumerate(cc):
+                        tot[j] += int(v)
+            want = None
+            if sum(tot):
+                h = Histogram(f"soak_check_{side}", "", buckets=bounds)
+                h.observe_bulk(tot, 0.0)
+                want = round(h.quantile(0.99), 6)
+            stated = led.get(f"p99_{side}_s")
+            if stated != want:
+                errs.append(f"interference p99_{side}_s {stated!r} "
+                            f"not reproducible from the embedded "
+                            f"{side}-arm timeline (recomputed "
+                            f"{want!r})")
+        d = led.get("p99_delta_s")
+        p_on, p_off = led.get("p99_on_s"), led.get("p99_off_s")
+        if _num(p_on) and _num(p_off):
+            if not (_num(d) and abs(d - round(p_on - p_off, 6))
+                    <= 1e-9):
+                errs.append(f"interference p99_delta_s {d!r} != "
+                            f"p99_on - p99_off "
+                            f"{round(p_on - p_off, 6)}")
+        if bench.get("maint_interference_p99_delta_s") != d:
+            errs.append(
+                f"bench maint_interference_p99_delta_s "
+                f"{bench.get('maint_interference_p99_delta_s')!r} != "
+                f"ledger {d!r}")
+
+    # bench-row consistency -------------------------------------------
+    rate = bench.get("value")
+    el = bench.get("elapsed_s")
+    if _num(rate) and _num(el) and el > 0:
+        want = serve_com / el
+        if abs(rate - want) > max(0.02 * want, 0.5):
+            errs.append(f"bench sustained rate {rate} inconsistent "
+                        f"with completed/elapsed = {want:.1f}")
+    if bench.get("wclass_mismatches") != 0:
+        errs.append(f"bench wclass_mismatches "
+                    f"{bench.get('wclass_mismatches')!r} != 0")
+    return errs
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) != 1:
@@ -710,6 +1124,22 @@ def main(argv=None) -> int:
         print(f"check_trace: serve OK — {life['completed']} completed "
               f"({life['in_flight']} in flight), p50 "
               f"{q['p50'] * 1e3:.1f} ms, p99 {q['p99'] * 1e3:.1f} ms")
+        return 0
+    if obj.get("kind") == "swarm_soak_trace":
+        errs = check_soak_obj(obj)
+        if errs:
+            for e in errs:
+                print(f"check_trace: {e}")
+            return 1
+        b = obj["bench"]
+        led = obj.get("interference") or {}
+        delta = led.get("p99_delta_s")
+        print(f"check_trace: soak OK — {b['completed']} served at "
+              f"{b['value']} req/s with {b['repub_sweeps']} repub + "
+              f"{b['monitor_sweeps']} monitor sweeps interleaved, "
+              f"p99 {b['latency_p99_s'] * 1e3:.1f} ms"
+              + (f" (maintenance delta {delta * 1e3:+.1f} ms)"
+                 if delta is not None else ""))
         return 0
     if obj.get("kind") == "swarm_monitor_trace":
         errs = check_monitor_obj(obj)

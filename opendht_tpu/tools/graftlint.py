@@ -138,6 +138,7 @@ REGISTRY_MODULES = {
     "opendht_tpu.models.swarm": "opendht_tpu/models/swarm.py",
     "opendht_tpu.models.storage": "opendht_tpu/models/storage.py",
     "opendht_tpu.models.serve": "opendht_tpu/models/serve.py",
+    "opendht_tpu.models.soak": "opendht_tpu/models/soak.py",
     "opendht_tpu.models.monitor": "opendht_tpu/models/monitor.py",
     "opendht_tpu.models.index": "opendht_tpu/models/index.py",
     "opendht_tpu.ops.sha1": "opendht_tpu/ops/sha1.py",
@@ -1561,10 +1562,54 @@ def _build_workloads():
         eng = mon.MonitorEngine(swarm, cfg)
         eng.sweep(jax.random.PRNGKey(11))    # fold_sweep
 
+    def soak_engine():
+        # The soak work-class plane jits, driven directly at loop
+        # shapes (ISSUE 11): tagged serve admission, the fused
+        # maintenance admit (state + plane donated), the interleaved
+        # sweep fold, and the snapshot with per-class active counts.
+        # Every donated operand is freshly built and never reused.
+        from ..models import soak as sk
+        c, a = 256, 128
+        eng = sk.SoakEngine(swarm, cfg, slots=c, admit_cap=a)
+        st = eng.serve.empty()
+        st = eng.admit_serve(
+            st, targets[:a], jnp.arange(a, dtype=jnp.int32),
+            np.zeros(a, np.int32), key, 0)
+        pool = jax.random.bits(jax.random.PRNGKey(21), (64, 5),
+                               jnp.uint32)
+        wc2 = jnp.zeros((c,), jnp.int32)
+        st, _wc = sk._admit_maintenance(
+            swarm, cfg, st, wc2, pool,
+            jnp.arange(a, dtype=jnp.int32) % 64,
+            jnp.full((a,), c, jnp.int32),
+            sw._sample_origins(jax.random.PRNGKey(22), swarm.alive,
+                               a),
+            dev_i32(0), dev_i32(sk.WC_REPUB))
+        buf = jnp.full((64, cfg.quorum), -1, jnp.int32)
+        sk._fold_completed(buf, swarm.ids, st, cfg,
+                           jnp.zeros((a,), jnp.int32),
+                           jnp.full((a,), 64, jnp.int32))
+        # Micro-batch republish insert at a fully-masked batch (pos
+        # sentinel) — fresh store + accumulator, both donated.
+        scfg_s = stg.StoreConfig(slots=4, listen_slots=2,
+                                 max_listeners=64, payload_words=0)
+        store_s = stg.empty_store(cfg.n_nodes, scfg_s)
+        z32 = jnp.zeros((64,), jnp.uint32)
+        sk._repub_insert_completed(
+            swarm.ids, swarm.alive, cfg, scfg_s, store_s, st,
+            jnp.zeros((a,), jnp.int32),
+            jnp.full((a,), 64, jnp.int32),
+            jnp.zeros((64, 5), jnp.uint32), z32, z32, z32, z32,
+            jnp.zeros((64, 0), jnp.uint32),
+            jnp.zeros((64,), bool),
+            jnp.asarray([0, 0, 2 ** 30], jnp.int32), dev_u32(0))
+        sk._soak_snapshot(swarm, cfg, st, eng.wc)
+
     return {
         "local-engines": local_engines,
         "compaction-plumbing": compaction_plumbing,
         "serve-engine": serve_engine,
+        "soak-engine": soak_engine,
         "storage-paths": storage_paths,
         "index-kernels": index_kernels,
         "monitor-sweep": monitor_sweep,

@@ -1,0 +1,616 @@
+"""Always-on soak engine: superset equivalence, timeline conservation,
+work-class plane integrity, and the soak artifact checker.
+
+Three contracts (ISSUE 11):
+
+* **pure superset** — a soak run with maintenance and monitor disabled
+  is BIT-identical (found/hops/done/latency samples, marks, counters)
+  to the plain serve loop on the same arrival schedule under the same
+  virtual clock: the soak wrapper adds, it never perturbs;
+* **conservation** — per timeline interval, serve + maintenance
+  slot-rounds (device work-class plane) equal total dispatched rounds
+  (host bookkeeping), and ``admitted == completed + expired +
+  in_flight`` holds per work class at EVERY interval boundary, not
+  just at drain;
+* **checked artifact** — ``check_soak_obj`` accepts a consistent
+  ``swarm_soak_trace`` and rejects each fabricated field (slot-round
+  split drift, broken boundary conservation, out-of-bucket quantiles,
+  burned SLO, survival below floor, inconsistent interference ledger).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.models.monitor import MonitorConfig, MonitorEngine
+from opendht_tpu.models.serve import (
+    ServeEngine,
+    poisson_zipf_events,
+    serve_open_loop,
+)
+from opendht_tpu.models.soak import (
+    MAINT_CLASSES,
+    N_WORK_CLASSES,
+    WORK_CLASS_NAMES,
+    ScenarioEvent,
+    SoakConfig,
+    SoakEngine,
+    _soak_snapshot,
+    mixed_events,
+    soak_open_loop,
+)
+from opendht_tpu.models.storage import StoreConfig, announce, empty_store
+from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+from opendht_tpu.obs.latency import LatencyPlane
+from opendht_tpu.obs.timeline import (
+    SoakPlane,
+    SoakTimeline,
+    interference_ledger,
+)
+from opendht_tpu.tools.check_bench import check_bench_rows
+from opendht_tpu.tools.check_trace import check_soak_obj
+from opendht_tpu.utils.metrics import Histogram, MetricsRegistry
+
+CFG = SwarmConfig.for_nodes(2048)
+
+
+def virtual_clock(step: float = 0.002):
+    t = [0.0]
+
+    def clock():
+        t[0] += step
+        return t[0]
+
+    def sleep(s):
+        t[0] += s
+
+    return clock, sleep
+
+
+@pytest.fixture(scope="module")
+def swarm():
+    return build_swarm(jax.random.PRNGKey(7), CFG)
+
+
+@pytest.fixture(scope="module")
+def schedule():
+    return poisson_zipf_events(rate=300, duration=2.0, key_pool=256,
+                               zipf_s=1.1, seed=7)
+
+
+class TestSupersetEquivalence:
+    def test_maintenance_off_bit_identical_to_serve(self, swarm,
+                                                    schedule):
+        ts, keys, klass = schedule
+        c1, s1 = virtual_clock()
+        eng = ServeEngine(swarm, CFG, slots=128, admit_cap=32)
+        rs = serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                             klass=klass, burst=2, duration=2.0,
+                             clock=c1, sleep=s1)
+        c2, s2 = virtual_clock()
+        soak = SoakEngine(swarm, CFG, slots=128, admit_cap=32)
+        rk = soak_open_loop(soak, ts, keys, jax.random.PRNGKey(3),
+                            klass=klass, burst=2, duration=2.0,
+                            maintenance=False, clock=c2, sleep=s2)
+        for k in ("admitted", "completed", "expired", "in_flight",
+                  "never_admitted", "rounds", "elapsed_s",
+                  "queue_depth_mean", "queue_depth_max",
+                  "slot_occupancy_frac"):
+            assert rs[k] == rk[k], k
+        for k in ("request", "latency_s", "hops", "service_rounds",
+                  "found_nonempty", "klass"):
+            assert np.array_equal(np.asarray(rs[k]),
+                                  np.asarray(rk[k])), k
+        assert rs["burst_marks"] == rk["burst_marks"]
+        assert rk["completed"] > 0
+
+    def test_return_draw_is_pure_extension(self):
+        a = poisson_zipf_events(100, 1.0, 64, 1.1, seed=3)
+        b = poisson_zipf_events(100, 1.0, 64, 1.1, seed=3,
+                                return_draw=True)
+        assert len(b) == 4
+        assert np.array_equal(a[0], b[0])
+        assert np.array_equal(np.asarray(a[1]), np.asarray(b[1]))
+        assert np.array_equal(a[2], b[2])
+
+
+class TestMixedEvents:
+    def test_fraction_validation(self):
+        with pytest.raises(ValueError):
+            mixed_events(100, 1.0, 64, 1.1, write_frac=1.2)
+        with pytest.raises(ValueError):
+            mixed_events(100, 1.0, 64, 1.1, scan_frac=-0.1)
+        with pytest.raises(ValueError):
+            mixed_events(100, 1.0, 64, 1.1, write_frac=0.7,
+                         scan_frac=0.4)
+
+    def test_ops_and_windows(self):
+        ts, keys, klass, ops, lo, hi = mixed_events(
+            400, 2.0, 64, 1.1, seed=5, write_frac=0.3, scan_frac=0.2,
+            scan_span=8)
+        assert set(np.unique(ops)) <= {"read", "write", "scan"}
+        r = len(ts)
+        wf = float(np.mean(ops == "write"))
+        sf = float(np.mean(ops == "scan"))
+        assert abs(wf - 0.3) < 0.1 and abs(sf - 0.2) < 0.1
+        assert (lo <= hi).all() and (hi < 64).all() and (lo >= 0).all()
+        assert (hi - lo <= 7).all()
+        # The underlying schedule is poisson_zipf_events verbatim.
+        ts2, keys2, klass2 = poisson_zipf_events(400, 2.0, 64, 1.1,
+                                                 seed=5)
+        assert np.array_equal(ts, ts2)
+        assert np.array_equal(np.asarray(keys), np.asarray(keys2))
+
+
+@pytest.fixture(scope="module")
+def soak_run(swarm):
+    """One maintained soak run under churn + outage with writes, on a
+    virtual clock — the fixture every conservation test reads."""
+    scfg = StoreConfig(slots=4, listen_slots=2, max_listeners=64,
+                       payload_words=0)
+    store = empty_store(CFG.n_nodes, scfg)
+    pk = jax.random.bits(jax.random.PRNGKey(11), (256, 5), jnp.uint32)
+    store, _ = announce(swarm, CFG, store, scfg, pk,
+                        jnp.arange(256, dtype=jnp.uint32) + 1,
+                        jnp.ones((256,), jnp.uint32), 0,
+                        jax.random.PRNGKey(12))
+    mon = MonitorEngine(swarm, CFG, MonitorConfig.for_nodes(2048))
+    soak = SoakEngine(
+        swarm, CFG, slots=256, scfg=scfg, store=store, monitor=mon,
+        admit_cap=64,
+        soak_cfg=SoakConfig(interval_s=0.5, repub_period_s=1.0,
+                            maint_cap=64, write_flush=64))
+    ts, keys, klass, ops, lo, hi = mixed_events(
+        400, 3.0, 256, 1.1, seed=7, write_frac=0.2)
+    clock, sleep = virtual_clock()
+    tl = SoakTimeline(0.5, 256, slo_target_s=0.4)
+    plane = LatencyPlane(MetricsRegistry(),
+                         prefix="dht_soak_request",
+                         label_names=("op",), slo_target_s=0.4)
+    rep = soak_open_loop(
+        soak, ts, keys, jax.random.PRNGKey(3), klass=klass, ops=ops,
+        burst=2, duration=3.0,
+        scenario=(ScenarioEvent(1.0, "churn", 0.05),
+                  ScenarioEvent(1.8, "outage", 0.02)),
+        timeline=tl, latency_plane=plane, clock=clock, sleep=sleep)
+    return soak, tl, rep, plane
+
+
+class TestSoakConservation:
+    def test_slot_round_split_equals_total(self, soak_run):
+        _, tl, _, _ = soak_run
+        assert tl.rows
+        for r in tl.rows:
+            assert r["total_slot_rounds"] == sum(
+                r["slot_rounds"].values()), r["i"]
+
+    def test_maintenance_actually_interleaved(self, soak_run):
+        _, tl, rep, _ = soak_run
+        maint = sum(sum(r["slot_rounds"][w] for w in ("repub",
+                                                      "monitor"))
+                    for r in tl.rows)
+        assert maint > 0
+        assert rep["repub_sweeps"] and rep["monitor_sweeps"]
+
+    def test_boundary_conservation_every_interval(self, soak_run):
+        _, tl, _, _ = soak_run
+        seen = 0
+        for r in tl.rows:
+            lf = r["lifecycle"]
+            if lf is None:
+                continue
+            seen += 1
+            for cls, d in lf.items():
+                assert d["admitted"] == d["completed"] + d["expired"] \
+                    + d["in_flight"], (r["i"], cls)
+        assert seen >= 3
+
+    def test_run_level_lifecycle_per_class(self, soak_run):
+        _, _, rep, _ = soak_run
+        for cls, d in rep["lifecycle_by_class"].items():
+            assert d["admitted"] == d["completed"] + d["expired"] \
+                + d["in_flight"], cls
+        assert rep["lifecycle_by_class"]["read"]["completed"] > 0
+        assert rep["lifecycle_by_class"]["write"]["completed"] > 0
+
+    def test_wclass_plane_matches_host(self, soak_run):
+        _, _, rep, _ = soak_run
+        assert rep["wclass_mismatches"] == 0
+
+    def test_interval_latency_counts_match_completions(self, soak_run):
+        _, tl, rep, _ = soak_run
+        for r in tl.rows:
+            serve_done = r["completed"]["read"] + \
+                r["completed"]["write"]
+            assert serve_done == sum(r["latency_counts"]), r["i"]
+        total = sum(sum(r["latency_counts"]) for r in tl.rows)
+        assert total == rep["completed"]
+
+    def test_monitor_sweeps_conserve(self, soak_run):
+        from opendht_tpu.tools.check_trace import \
+            _check_sweep_conservation
+        soak, _, rep, _ = soak_run
+        errs = []
+        _check_sweep_conservation(
+            soak.mon.records, soak.mon.mcfg.detection_lag_bound, errs)
+        assert errs == []
+        assert len(soak.mon.records) == len(rep["monitor_sweeps"])
+
+    def test_detection_lag_within_bound(self, soak_run):
+        soak, _, _, _ = soak_run
+        lags = [r["lag_max"] for r in soak.mon.records
+                if r["lag_count"]]
+        assert lags, "no deaths detected under churn + outage"
+        assert max(lags) <= soak.mon.mcfg.detection_lag_bound
+
+    def test_repub_sweep_records_conserve(self, soak_run):
+        _, _, rep, _ = soak_run
+        for sw in rep["repub_sweeps"]:
+            assert sw["admitted"] == sw["completed"] + sw["expired"] \
+                + sw["in_flight"]
+            assert sw["admitted"] <= sw["rows"]
+
+    def test_latency_plane_windows_drain(self, soak_run):
+        _, _, rep, plane = soak_run
+        n, over = plane.take_window()
+        # Everything observed during the run lands in the first drain;
+        # the second drain must be empty.
+        assert n == rep["completed"] + rep["scan"]["completed"]
+        assert 0 <= over <= n
+        assert plane.take_window() == (0, 0)
+
+
+class TestWorkClassPlane:
+    def test_snapshot_counts_active_by_class(self, swarm):
+        eng = SoakEngine(swarm, CFG, slots=64, admit_cap=16)
+        st = eng.serve.empty()
+        keys = jax.random.bits(jax.random.PRNGKey(1), (16, 5),
+                               jnp.uint32)
+        cls = np.array([0, 1] * 8, np.int32)
+        st = eng.admit_serve(st, keys,
+                             jnp.arange(16, dtype=jnp.int32), cls,
+                             jax.random.PRNGKey(2), 0)
+        *_, counts = jax.device_get(
+            _soak_snapshot(swarm, CFG, st, eng.wc))
+        assert counts[0] == 8 and counts[1] == 8
+        assert counts[2] == 0 and counts[3] == 0
+        assert counts.sum() == 16
+
+
+# ---------------------------------------------------------------------------
+# checker fixtures: a small consistent artifact, then targeted breaks
+# ---------------------------------------------------------------------------
+
+BOUNDS = [0.1, 0.2, 0.4]
+
+
+def _life(adm, com, exp=0, inf=0):
+    return {"admitted": adm, "completed": com, "expired": exp,
+            "in_flight": inf}
+
+
+def _quants(counts, names=("p50", "p95", "p99", "p999")):
+    h = Histogram("t", "", buckets=BOUNDS)
+    h.observe_bulk(counts, 0.0)
+    qs = {"p50": 0.50, "p95": 0.95, "p99": 0.99, "p999": 0.999}
+    return {n: round(h.quantile(qs[n]), 6) for n in names}
+
+
+def _mk_row(i, read_done, counts, life, slot_rounds, viol=0):
+    q = _quants(counts, ("p50", "p99"))
+    n = sum(counts)
+    return {
+        "i": i, "t_start": i * 0.5, "t_end": (i + 1) * 0.5,
+        "arrivals": {"read": read_done, "write": 0, "repub": 0,
+                     "monitor": 0, "scan": 0},
+        "admitted": {"read": read_done, "write": 0, "repub": 0,
+                     "monitor": 0},
+        "completed": {"read": read_done, "write": 0, "repub": 0,
+                      "monitor": 0, "scan": 0},
+        "expired": {"read": 0, "write": 0, "repub": 0, "monitor": 0},
+        "bursts": 2, "rounds": 4,
+        "total_slot_rounds": sum(slot_rounds.values()),
+        "slot_rounds": dict(slot_rounds),
+        "latency_counts": list(counts),
+        "latency_count": n,
+        "latency_sum_s": 0.05 * n,
+        "latency_p50_s": q["p50"] if n else None,
+        "latency_p99_s": q["p99"] if n else None,
+        "slo_violations": viol,
+        "scan_latency_sum_s": 0.0,
+        "maint_ops": 0, "maint_ops_wall_s": 0.0, "ops": [],
+        "sweeps_finished": {"repub": 0, "monitor": 0},
+        "coverage": None,
+        "lifecycle": life,
+        "queue_depth_mean": 0.0, "queue_depth_max": 0,
+        "occupancy_serve": 0.1, "occupancy_maint": 0.05,
+    }
+
+
+def _mk_sweep_record(sweep=0, seen=10):
+    return {
+        "sweep": sweep, "buckets_probed": 4, "lookups": 4,
+        "done_frac": 1.0, "nodes_seen": seen,
+        "newly_discovered": seen if sweep == 0 else 0,
+        "resurrected": 0, "newly_dead": 0, "tracked_alive": 10,
+        "tracked_alive_before": 0 if sweep == 0 else 10,
+        "covered": 10, "actual_alive": 10, "false_alive": 0,
+        "false_dead": 0, "probed_tracked": 0 if sweep == 0 else seen,
+        "probed_seen": 0 if sweep == 0 else seen, "probed_missed": 0,
+        "lag_sum": 0, "lag_count": 0, "lag_max": -1,
+        "nodes_fresh": seen, "coverage": 1.0, "age_p50": 0,
+        "age_p99": 1,
+    }
+
+
+def _valid_soak_obj():
+    rows = [
+        _mk_row(0, 4, [4, 0, 0, 0],
+                {"read": _life(5, 4, 0, 1),
+                 "write": _life(0, 0),
+                 "repub": _life(4, 4),
+                 "monitor": _life(8, 8)},
+                {"read": 16, "write": 0, "repub": 8, "monitor": 16}),
+        _mk_row(1, 2, [1, 1, 0, 0],
+                {"read": _life(7, 6, 0, 1),
+                 "write": _life(0, 0),
+                 "repub": _life(4, 4),
+                 "monitor": _life(8, 8)},
+                {"read": 8, "write": 0, "repub": 0, "monitor": 0}),
+    ]
+    counts = [5, 1, 0, 0]
+    tl = {"interval_s": 0.5, "slots": 64, "slo_target_s": 0.4,
+          "latency_bounds_s": BOUNDS, "rows": rows}
+    off_rows = [
+        _mk_row(0, 4, [4, 0, 0, 0], None,
+                {"read": 16, "write": 0, "repub": 0, "monitor": 0}),
+        _mk_row(1, 2, [2, 0, 0, 0], None,
+                {"read": 8, "write": 0, "repub": 0, "monitor": 0}),
+    ]
+    tl_off = {"interval_s": 0.5, "slots": 64, "slo_target_s": 0.4,
+              "latency_bounds_s": BOUNDS, "rows": off_rows}
+    led = interference_ledger(tl, tl_off)
+    sweeps = [_mk_sweep_record(0), _mk_sweep_record(1)]
+    from opendht_tpu.obs.health import summarize_sweeps
+    q = _quants(counts)
+    bench = {
+        "metric": "swarm_soak_req_per_sec", "value": 6.0,
+        "unit": "req/s", "platform": "cpu",
+        "elapsed_s": 1.0,
+        "admitted": 7, "completed": 6, "expired": 0, "in_flight": 1,
+        "latency_p50_s": q["p50"], "latency_p95_s": q["p95"],
+        "latency_p99_s": q["p99"], "latency_p999_s": q["p999"],
+        "slo_violation_ratio": 0.0, "slo_violation_max": 0.1,
+        "wclass_mismatches": 0, "outage_frac": 0.0,
+        "repub_sweeps": 1, "monitor_sweeps": 2,
+        "detection_lag_max": None,
+        "detection_lag_bound_sweeps": 5,
+        "monitor_coverage": 1.0,
+        "value_survival_final": 1.0,
+        "maint_interference_p99_delta_s": led["p99_delta_s"],
+    }
+    return {
+        "kind": "swarm_soak_trace",
+        "bench": bench,
+        "lifecycle": {
+            "by_class": {"read": _life(7, 6, 0, 1),
+                         "write": _life(0, 0),
+                         "repub": _life(4, 4),
+                         "monitor": _life(8, 8)},
+            "admitted": 7, "completed": 6, "expired": 0,
+            "in_flight": 1, "never_admitted": 0,
+            "wclass_mismatches": 0,
+            "scan": {"arrived": 0, "completed": 0, "pending": 0},
+        },
+        "timeline": tl,
+        "timeline_off": tl_off,
+        "interference": led,
+        "monitor": {
+            "config": {"period": 4, "miss_limit": 2,
+                       "detection_lag_bound_sweeps": 5},
+            "sweeps": sweeps,
+            "summary": summarize_sweeps(sweeps),
+        },
+        "repub": {
+            "period_s": 1.0,
+            "sweeps": [{"began_t": 0.0, "finished_t": 0.5,
+                        "rows": 8, "live_rows": 8, "batch_rows": 64,
+                        "admitted": 8, "completed": 8, "expired": 0,
+                        "in_flight": 0, "replicas_mean": 5.0,
+                        "replicas_min": 2}],
+            "survival_initial": 1.0, "survival_final": 1.0,
+            "survival_off_arm": 0.98, "survival_floor": 0.999,
+            "tracked_values": 256,
+        },
+        "latency_histogram": {"bounds": BOUNDS, "counts": counts,
+                              "sum": 0.3, "count": 6},
+        "latency_quantiles_s": q,
+    }
+
+
+class TestSoakChecker:
+    def test_valid_artifact_passes(self):
+        assert check_soak_obj(_valid_soak_obj()) == []
+
+    def test_slot_round_split_drift_flagged(self):
+        obj = _valid_soak_obj()
+        obj["timeline"]["rows"][0]["slot_rounds"]["repub"] += 4
+        assert any("slot-rounds" in e for e in check_soak_obj(obj))
+
+    def test_boundary_conservation_break_flagged(self):
+        obj = _valid_soak_obj()
+        obj["timeline"]["rows"][0]["lifecycle"]["read"]["completed"] \
+            += 1
+        assert any("boundary conservation" in e
+                   for e in check_soak_obj(obj))
+
+    def test_run_lifecycle_break_flagged(self):
+        obj = _valid_soak_obj()
+        obj["lifecycle"]["by_class"]["repub"]["admitted"] += 1
+        errs = check_soak_obj(obj)
+        assert any("does not conserve" in e for e in errs)
+
+    def test_wclass_mismatch_flagged(self):
+        obj = _valid_soak_obj()
+        obj["lifecycle"]["wclass_mismatches"] = 2
+        assert any("work-class plane" in e for e in check_soak_obj(obj))
+
+    def test_fabricated_interval_p99_flagged(self):
+        obj = _valid_soak_obj()
+        obj["timeline"]["rows"][0]["latency_p99_s"] = 0.39
+        assert any("outside its histogram bucket" in e
+                   for e in check_soak_obj(obj))
+
+    def test_fabricated_bench_quantile_flagged(self):
+        obj = _valid_soak_obj()
+        obj["bench"]["latency_p99_s"] = 0.001
+        assert any("latency_p99_s" in e for e in check_soak_obj(obj))
+
+    def test_histogram_interval_sum_mismatch_flagged(self):
+        obj = _valid_soak_obj()
+        obj["latency_histogram"]["counts"] = [6, 0, 0, 0]
+        assert any("sum of interval histograms" in e
+                   for e in check_soak_obj(obj))
+
+    def test_burned_slo_flagged(self):
+        obj = _valid_soak_obj()
+        obj["bench"]["slo_violation_ratio"] = 0.2
+        errs = check_soak_obj(obj)
+        assert any("SLO is burned" in e or "slo_violation_ratio" in e
+                   for e in errs)
+
+    def test_loose_slo_bound_flagged(self):
+        obj = _valid_soak_obj()
+        obj["bench"]["slo_violation_max"] = 0.9
+        assert any("ceiling" in e for e in check_soak_obj(obj))
+
+    def test_survival_below_floor_flagged(self):
+        obj = _valid_soak_obj()
+        obj["repub"]["survival_final"] = 0.9
+        obj["bench"]["value_survival_final"] = 0.9
+        assert any("re-replication did not complete" in e
+                   for e in check_soak_obj(obj))
+
+    def test_loose_survival_floor_flagged(self):
+        obj = _valid_soak_obj()
+        obj["repub"]["survival_floor"] = 0.5
+        assert any("survival_floor" in e for e in check_soak_obj(obj))
+
+    def test_sweep_conservation_reused_from_monitor(self):
+        obj = _valid_soak_obj()
+        obj["monitor"]["sweeps"][1]["tracked_alive"] = 99
+        assert any("freshness does not conserve" in e
+                   for e in check_soak_obj(obj))
+
+    def test_lag_over_bound_flagged(self):
+        obj = _valid_soak_obj()
+        sw = obj["monitor"]["sweeps"][1]
+        sw["lag_count"] = 1
+        sw["lag_sum"] = 9
+        sw["lag_max"] = 9
+        sw["newly_dead"] = 1
+        sw["tracked_alive"] = 9
+        from opendht_tpu.obs.health import summarize_sweeps
+        obj["monitor"]["summary"] = summarize_sweeps(
+            obj["monitor"]["sweeps"])
+        obj["bench"]["detection_lag_max"] = 9
+        errs = check_soak_obj(obj)
+        assert any("lag" in e for e in errs)
+
+    def test_fabricated_interference_flagged(self):
+        obj = _valid_soak_obj()
+        obj["interference"]["p99_delta_s"] = -1.0
+        obj["bench"]["maint_interference_p99_delta_s"] = -1.0
+        assert any("p99_delta_s" in e for e in check_soak_obj(obj))
+
+    def test_interference_arm_not_reproducible_flagged(self):
+        obj = _valid_soak_obj()
+        obj["interference"]["p99_off_s"] = 0.001
+        assert any("not reproducible" in e
+                   for e in check_soak_obj(obj))
+
+
+class TestSoakBenchGate:
+    def test_row_gates_against_itself(self):
+        row = _valid_soak_obj()["bench"]
+        assert check_bench_rows(row, dict(row)) == []
+
+    def test_survival_regression_fails(self):
+        row = _valid_soak_obj()["bench"]
+        cur = dict(row, value_survival_final=0.9)
+        assert any("re-replication regressed" in e
+                   for e in check_bench_rows(cur, row))
+
+    def test_lag_over_recorded_bound_fails(self):
+        row = _valid_soak_obj()["bench"]
+        cur = dict(row, detection_lag_max=9)
+        assert any("sweep-period bound" in e
+                   for e in check_bench_rows(cur, row))
+
+    def test_slo_burn_fails(self):
+        row = _valid_soak_obj()["bench"]
+        cur = dict(row, slo_violation_ratio=0.5)
+        assert any("slo_violation_ratio" in e
+                   for e in check_bench_rows(cur, row))
+
+    def test_wclass_mismatch_fails(self):
+        row = _valid_soak_obj()["bench"]
+        cur = dict(row, wclass_mismatches=1)
+        assert any("work-class plane" in e
+                   for e in check_bench_rows(cur, row))
+
+    def test_coverage_floor_fails(self):
+        row = _valid_soak_obj()["bench"]
+        cur = dict(row, monitor_coverage=0.5)
+        assert any("monitor_coverage" in e
+                   for e in check_bench_rows(cur, row))
+
+
+class TestTimelineUnit:
+    def test_rolling_and_close(self):
+        tl = SoakTimeline(0.5, 16, bounds=BOUNDS, slo_target_s=0.1)
+        tl.note_arrival("read", 0.1)
+        tl.note_complete("read", 0.05, 0.2)
+        tl.note_complete("read", 0.3, 0.7)   # rolls into row 1, slow
+        tl.note_burst(2, [1, 0, 0, 0], [1, 0, 0, 0], [0, 0, 0, 0],
+                      0.8)
+        tl.close(0.9)
+        assert len(tl.rows) == 2
+        r0, r1 = tl.rows
+        assert r0["latency_count"] == 1 and r0["slo_violations"] == 0
+        assert r1["latency_count"] == 1 and r1["slo_violations"] == 1
+        assert r1["total_slot_rounds"] == 2
+        assert r1["slot_rounds"]["read"] == 2
+
+    def test_scan_completions_excluded_from_histogram(self):
+        tl = SoakTimeline(0.5, 16, bounds=BOUNDS)
+        tl.note_complete("scan", 0.05, 0.1)
+        tl.close(0.2)
+        assert tl.rows[0]["completed"]["scan"] == 1
+        assert sum(tl.rows[0]["latency_counts"]) == 0
+
+    def test_interference_requires_aligned_arms(self):
+        a = SoakTimeline(0.5, 16, bounds=BOUNDS)
+        b = SoakTimeline(0.25, 16, bounds=BOUNDS)
+        a.close(0.5)
+        b.close(0.5)
+        with pytest.raises(ValueError):
+            interference_ledger(a.to_obj(), b.to_obj())
+
+    def test_soak_plane_publishes(self):
+        reg = MetricsRegistry()
+        plane = SoakPlane(reg)
+        tl = SoakTimeline(0.5, 16, bounds=BOUNDS)
+        tl.note_admit({"read": 3}, 0.1)
+        tl.note_complete("read", 0.05, 0.2)
+        tl.note_burst(2, [1, 0, 1, 0], [1, 0, 1, 0], [0, 0, 0, 0],
+                      0.3)
+        tl.close(0.4)
+        for row in tl.rows:
+            plane.publish_interval(row)
+        text = reg.render_prometheus()
+        assert "dht_soak_slot_rounds_total" in text
+        assert "dht_soak_requests_total" in text
+        assert "dht_soak_occupancy_ratio" in text
