@@ -123,6 +123,42 @@ gate: lint test
 	python bench.py --mode serve --nodes 16384 --arrival-rate 2000 --duration 3 --serve-slots 1024 --key-pool 1024 --serve-out /tmp/serve.json
 	python -m opendht_tpu.tools.check_trace /tmp/serve.json
 	python -m opendht_tpu.tools.check_bench /tmp/serve.json BENCH_GATE_r07.json
+# Round-16 serving legs.  (1) CACHE-ON serve at the same 16k/Zipf
+# schedule shape as the r07 leg but 4x the arrival rate: the device
+# hot-key result cache answers the Zipf head at admission (zero
+# rounds, zero slots), so sustained rate must hold >= 3x the r07
+# 1,930 req/s row (recorded here: 7,580 req/s, hit frac 0.78, p99
+# 242 ms).  check_trace proves the cache conservation planes (hits +
+# misses == admitted, lifecycle cache_hits == cache block hits, every
+# hit sample in the FIRST service-rounds bucket); check_bench floors
+# rate/hit-frac and ceilings p99 vs BENCH_GATE_r12.json (0.90 floor:
+# the open loop's drain tail is noisier than the closed legs).  The
+# r07 leg above stays UNCHANGED and still gates vs BENCH_GATE_r07 —
+# that IS the cache-off pure-overlay leg: same programs, byte-
+# identical engine (proven bit-identical in tests/test_serve.py).
+	python bench.py --mode serve --nodes 16384 --arrival-rate 8000 --duration 3 --serve-slots 1024 --key-pool 1024 --serve-cache 2048 --serve-out /tmp/serve_cache.json
+	python -m opendht_tpu.tools.check_trace /tmp/serve_cache.json
+	python -m opendht_tpu.tools.check_bench /tmp/serve_cache.json BENCH_GATE_r12.json --min-ratio 0.90
+# (2) FIRST-CLASS SHARDED serve: the mesh engine (routed per-round
+# exchanges, replicated cache) driven open-loop at 65k nodes on the
+# 8-device virtual mesh, gated vs BENCH_GATE_r12_sharded.json (0.90
+# floor + 2.0x p99 ceiling: collective walls on the virtual CPU mesh
+# are spikier than the local engine's).  The closed-loop replay
+# bit-identity vs sharded_lookup rides the `test` prerequisite.
+	env XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu python bench.py --mode serve --sharded --nodes 65536 --arrival-rate 1000 --duration 3 --serve-slots 1024 --key-pool 1024 --serve-cache 2048 --slo-ms 2000 --serve-out /tmp/serve_sharded.json
+	python -m opendht_tpu.tools.check_trace /tmp/serve_sharded.json
+	python -m opendht_tpu.tools.check_bench /tmp/serve_sharded.json BENCH_GATE_r12_sharded.json --min-ratio 0.90 --max-p99-ratio 2.0
+# (3) OVERLOAD sheds instead of exiting 2: a 20k req/s firehose
+# against 256 slots under policy `shed` — the engine must stay up,
+# finish, and conserve sheds in the lifecycle plane (check_trace
+# proves admitted == completed + in-flight + expired with shed in
+# the offered denominator).  Before round 16 this exact leg was a
+# guaranteed exit 2.
+	python bench.py --mode serve --nodes 16384 --arrival-rate 20000 --duration 2 --serve-slots 256 --key-pool 1024 --serve-cache 1024 --admission shed --admit-rate 2000 --serve-out /tmp/serve_shed.json
+	python -m opendht_tpu.tools.check_trace /tmp/serve_shed.json
+# (4) The committed 1M-node sharded acceptance artifact is
+# re-validated so the record can never rot.
+	python -m opendht_tpu.tools.check_trace SERVE_SHARDED_r12.json
 	python bench.py --mode crawl --nodes 100000 > /tmp/crawl_row.json
 	python -m opendht_tpu.tools.check_bench /tmp/crawl_row.json BENCH_GATE_r08.json
 	python bench.py --mode monitor --nodes 16384 --sweeps 3 --kill-frac 0.05 --monitor-out /tmp/monitor.json

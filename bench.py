@@ -194,9 +194,46 @@ def main():
                     help="serve mode: arrival-schedule horizon in "
                          "seconds (capped at 120 s so a serve leg can "
                          "never eat the tier-1 gate timeout)")
-    ap.add_argument("--serve-slots", type=int, default=2048,
+    def serve_slots_arg(s):
+        """int slot count or the literal 'auto'."""
+        return s if s == "auto" else int(s)
+
+    ap.add_argument("--serve-slots", default=2048,
+                    type=serve_slots_arg,
                     help="serve mode: resident lookup slots (finished "
-                         "rows' slots admit NEW requests mid-flight)")
+                         "rows' slots admit NEW requests mid-flight); "
+                         "'auto' sizes the slot plane from arrival "
+                         "rate x measured round wall (Little's law at "
+                         "0.5 target occupancy — the r07 0.15-"
+                         "occupancy finding) and logs the choice in "
+                         "the BENCH row")
+    ap.add_argument("--sharded", action="store_true",
+                    help="serve mode: drive the mesh serve engine "
+                         "(ShardedServeEngine — routed per-round "
+                         "exchanges over all available devices; "
+                         "slots and admit cap must divide the mesh)")
+    ap.add_argument("--serve-cache", type=int, default=0,
+                    help="serve mode: device hot-key result-cache "
+                         "slots (0 = off; the cache is a pure "
+                         "overlay — a hit completes in 0 rounds "
+                         "without occupying a lookup slot, misses "
+                         "are bit-identical to the cache-off engine)")
+    ap.add_argument("--admission",
+                    choices=("none", "shed", "queue", "degrade"),
+                    default="none",
+                    help="serve mode: per-class token-bucket "
+                         "admission policy (shed = drop over-quota "
+                         "requests and count them — overload no "
+                         "longer exits 2; queue = wait for tokens; "
+                         "degrade = answer over-quota hot keys from "
+                         "the result cache only, shed the rest)")
+    ap.add_argument("--admit-rate", type=float, default=0.0,
+                    help="serve mode: token-bucket refill rate per "
+                         "request class (req/s; required when "
+                         "--admission is not 'none')")
+    ap.add_argument("--admit-burst", type=float, default=None,
+                    help="serve mode: token-bucket burst ceiling "
+                         "(default: one second of --admit-rate)")
     ap.add_argument("--key-pool", type=int, default=4096,
                     help="serve mode: distinct-key universe the "
                          "Zipf-popular request keys draw from")
@@ -383,9 +420,44 @@ def main():
                      f"{args.mode} cap (the tier-1 gate runs under a "
                      f"870 s timeout; a longer open-loop run cannot "
                      f"fit a gate leg — split it into repeats)")
-        if args.serve_slots < 8:
+        if args.serve_slots == "auto":
+            if args.mode != "serve":
+                ap.error("--serve-slots auto is a serve-mode knob "
+                         "(the soak slot plane is sized explicitly)")
+        elif args.serve_slots < 8:
             ap.error(f"--serve-slots must be >= 8, got "
                      f"{args.serve_slots}")
+        if args.serve_cache < 0:
+            ap.error(f"--serve-cache must be >= 0, got "
+                     f"{args.serve_cache}")
+        if args.admission != "none" and args.admit_rate <= 0:
+            ap.error(f"--admission {args.admission} requires "
+                     f"--admit-rate > 0 req/s, got {args.admit_rate}")
+        if args.admit_burst is not None and args.admit_burst < 1:
+            ap.error(f"--admit-burst must be >= 1 token, got "
+                     f"{args.admit_burst}")
+        if args.admission == "degrade" and not args.serve_cache:
+            ap.error("--admission degrade answers from the result "
+                     "cache — set --serve-cache > 0")
+        if args.sharded and args.mode != "serve":
+            ap.error("--sharded is a serve-mode knob (sharded lookup "
+                     "benches are --mode sharded)")
+        if args.mode != "serve":
+            # The serve-only knobs must not be silently ignored: a
+            # soak run "with" a cache or admission policy that never
+            # engaged would be a lie in the artifact record.
+            if args.serve_cache:
+                ap.error("--serve-cache is a serve-mode knob (the "
+                         "soak loop does not consult the result "
+                         "cache yet — ROADMAP #1)")
+            if args.admission != "none":
+                ap.error("--admission/--admit-rate are serve-mode "
+                         "knobs")
+        if args.sharded and args.serve_slots == "auto":
+            ap.error("--serve-slots auto probes the LOCAL engine's "
+                     "round wall, which under-sizes the mesh plane "
+                     "(routed rounds pay collectives) — size "
+                     "--serve-slots explicitly with --sharded")
         if args.key_pool < 1:
             ap.error(f"--key-pool must be >= 1, got {args.key_pool}")
         if args.serve_burst < 1:
@@ -2582,10 +2654,12 @@ def serve_main(args):
     histogram⇄row consistency, quantiles inside their buckets).
     """
     from opendht_tpu.models.serve import (
-        ServeEngine, ServeOverloadError, poisson_zipf_events,
-        serve_open_loop,
+        AdmissionControl, ServeEngine, ServeOverloadError,
+        ShardedServeEngine, autotune_serve_slots, measure_round_wall,
+        poisson_zipf_events, serve_open_loop,
     )
-    from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+    from opendht_tpu.models.swarm import (SwarmConfig, build_swarm,
+                                          burst_schedule)
     from opendht_tpu.obs.latency import (LatencyPlane,
                                          publish_hop_histogram)
     from opendht_tpu.utils.metrics import Histogram, MetricsRegistry
@@ -2603,11 +2677,58 @@ def serve_main(args):
     ts, keys, klass = poisson_zipf_events(
         rate=args.arrival_rate, duration=args.duration,
         key_pool=args.key_pool, zipf_s=zipf_s, seed=7)
-    engine = ServeEngine(swarm, cfg, slots=args.serve_slots)
+
+    # --serve-slots auto: measure one round of a fully-occupied probe
+    # engine, size the plane by Little's law (autotune_serve_slots).
+    round_wall_probe = None
+    if args.serve_slots == "auto":
+        # Two-pass probe: the per-round wall grows with slot width, so
+        # a plane sized from a narrow probe under-estimates service
+        # time exactly when it picks a wide plane.  Measure at 512,
+        # size, then RE-measure at the candidate width (capped — a
+        # 65k-row probe would cost more than it informs) and re-size
+        # once; widths only move between two adjacent powers of two,
+        # so one refinement converges.
+        probe_w = 512
+        round_wall_probe = measure_round_wall(swarm, cfg,
+                                              slots=probe_w)
+        args.serve_slots = autotune_serve_slots(
+            cfg, args.arrival_rate, round_wall_probe)
+        if args.serve_slots > probe_w:
+            probe_w = min(args.serve_slots, 4096)
+            round_wall_probe = measure_round_wall(swarm, cfg,
+                                                  slots=probe_w)
+            args.serve_slots = autotune_serve_slots(
+                cfg, args.arrival_rate, round_wall_probe)
+        slots_mode = "auto"
+        print(f"bench: --serve-slots auto -> {args.serve_slots} "
+              f"(round wall {round_wall_probe * 1e3:.2f} ms at width "
+              f"{probe_w}, ~{burst_schedule(cfg) + 1} rounds/request)",
+              file=sys.stderr)
+    else:
+        slots_mode = "fixed"
+
+    if args.sharded:
+        from opendht_tpu.parallel import make_mesh
+        n_dev = len(jax.devices())
+        mesh = make_mesh(n_dev)
+        engine = ShardedServeEngine(
+            swarm, cfg, slots=args.serve_slots, mesh=mesh,
+            capacity_factor=2.0, cache_slots=args.serve_cache)
+    else:
+        n_dev = 1
+        engine = ServeEngine(swarm, cfg, slots=args.serve_slots,
+                             cache_slots=args.serve_cache)
+    admission = None
+    if args.admission != "none":
+        admission = AdmissionControl(rate=args.admit_rate,
+                                     burst=args.admit_burst,
+                                     policy=args.admission)
     try:
         rep = serve_open_loop(engine, ts, keys, jax.random.PRNGKey(3),
                               klass=klass, burst=args.serve_burst,
-                              duration=args.duration)
+                              duration=args.duration,
+                              admission=admission)
     except ServeOverloadError as e:
         print(f"bench: {e}", file=sys.stderr)
         sys.exit(2)
@@ -2644,7 +2765,7 @@ def serve_main(args):
                            if len(lat) else None)
            for name, q in (("p50", 0.50), ("p95", 0.95),
                            ("p99", 0.99), ("p999", 0.999))}
-    offered = rep["admitted"] + rep["never_admitted"]
+    offered = rep["admitted"] + rep["never_admitted"] + rep["shed"]
 
     out = {
         "metric": "swarm_serve_req_per_sec",
@@ -2668,6 +2789,22 @@ def serve_main(args):
         "completed": rep["completed"],
         "expired": rep["expired"],
         "in_flight": rep["in_flight"],
+        "shed": rep["shed"],
+        "sharded": bool(args.sharded),
+        "n_devices": n_dev,
+        "serve_slots_mode": slots_mode,
+        "round_wall_probe_s": (round(round_wall_probe, 6)
+                               if round_wall_probe is not None
+                               else None),
+        "cache_slots": rep["cache_slots"],
+        "cache_hits": rep["cache_hits"],
+        "cache_misses": rep["cache_misses"],
+        "cache_hit_frac": (round(rep["cache_hits"] / rep["admitted"],
+                                 4) if rep["admitted"] else None),
+        "degraded_hits": rep["degraded_hits"],
+        "admission_policy": rep["admission_policy"],
+        "admit_rate": (args.admit_rate if args.admission != "none"
+                       else None),
         "done_frac": round(rep["completed"] / offered, 6)
         if offered else 0.0,
         "found_nonempty_frac": round(
@@ -2710,6 +2847,8 @@ def serve_main(args):
                 "expired": rep["expired"],
                 "in_flight": rep["in_flight"],
                 "never_admitted": rep["never_admitted"],
+                "shed": rep["shed"],
+                "cache_hits": rep["cache_hits"],
             },
             "latency_histogram": {
                 "bounds": bounds,
@@ -2723,6 +2862,25 @@ def serve_main(args):
                             for r, w in rep["burst_marks"]],
             "metrics_prometheus": registry.render_prometheus(),
         }
+        if rep["cache_slots"]:
+            # Cache block: hit/miss accounting plus the hit SERVICE-
+            # rounds histogram — a hit completes in zero lookup
+            # rounds by construction (it never occupied a slot), so
+            # every hit sample must land in the first bucket; the
+            # checker re-derives both from the per-request arrays'
+            # invariant (service_rounds == 0 iff cache hit).
+            sr = rep["service_rounds"]
+            hit_sr = sr[sr == 0]
+            obj["cache"] = {
+                "slots": rep["cache_slots"],
+                "hits": rep["cache_hits"],
+                "misses": rep["cache_misses"],
+                "degraded_hits": rep["degraded_hits"],
+                "hit_rounds_histogram": {
+                    "bounds": [0.0, 1.0],
+                    "counts": [int(len(hit_sr)), 0, 0],
+                },
+            }
         with open(args.serve_out, "w") as f:
             json.dump(obj, f)
             f.write("\n")

@@ -36,12 +36,30 @@ indices, the host holds per-burst wall clocks, and
 with round-end walls linearly interpolated inside each burst (floored
 at the admission wall, so queueing delay is included and latency can
 never go negative on a sub-burst completion).
+
+Round 16 made the engine production-shaped across three layers, all
+strict overlays (cache off + no admission policy = the byte-identical
+r07 engine):
+
+* **device hot-key result cache** (:class:`ResultCache`) — consulted
+  inside the admission jit; a Zipf-hot key that completed before
+  answers in ZERO rounds without occupying a slot;
+* **per-class token-bucket admission** (:class:`AdmissionControl`) —
+  the host twin of the reference's ``rate_limiter.h``; policy
+  ``shed``/``queue``/``degrade``, and overload sheds gracefully
+  instead of raising;
+* **first-class sharded serve** — :class:`ShardedServeEngine` driven
+  open-loop by the bench (``--mode serve --sharded``), its closed-
+  loop replay bit-identical to ``sharded_lookup`` on the mesh, the
+  cache replicated across devices.
 """
 
 from __future__ import annotations
 
+import math
 import time
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -68,7 +86,147 @@ class ServeOverloadError(RuntimeError):
     """The open-loop arrival stream exceeds what the slot capacity can
     drain: the admission queue grew past the overload bound.  Raised
     with a clear message instead of letting the queue (and the run)
-    grow without bound — the serve bench surfaces it as a CLI error."""
+    grow without bound — the serve bench surfaces it as a CLI error.
+    With an :class:`AdmissionControl` of policy ``shed`` the engine
+    sheds the excess instead and never raises this."""
+
+
+# ---------------------------------------------------------------------------
+# device hot-key result cache
+# ---------------------------------------------------------------------------
+#
+# The Zipf workload sends 1 % of keys the overwhelming majority of
+# traffic (poisson_zipf_events' hot class), yet every request pays the
+# full multi-round lookup — the per-round-cost lever of arXiv:1408.3079
+# applied at the REQUEST level instead of the round level.  This cache
+# is the device twin of the host's ``core/node_cache.py`` (one
+# canonical answer per id, consulted before any network work): a
+# fixed-capacity direct-mapped result cache resident on device,
+# consulted INSIDE the admission jit — a hit completes in ~0 rounds
+# without ever occupying a lookup slot, a miss falls through to the
+# normal seed exchange.  Fills happen at harvest from completed rows;
+# invalidation is a device epoch the probe checks (store-insert paths
+# bump it via ``_cache_invalidate`` — the soak engine's write flush
+# does, tests drive it directly), so one announce retires every cached
+# answer at once, like the reference dropping its cached nodes on a
+# connectivity change (``clear_bad_nodes``).
+
+class ResultCache(NamedTuple):
+    """Device-resident hot-key result cache (a pytree of arrays).
+
+    Direct-mapped over ``K = keys.shape[0]`` slots: a key's slot is a
+    murmur-style mix of its five limbs mod K (``_cache_slot_of``), so
+    probe and fill are ONE gather / one scatter each — no sort, no
+    scan.  A colliding fill evicts (hot keys re-fill within one
+    harvest, cold keys were never worth keeping).  An entry is live
+    iff its ``fill_epoch`` equals the scalar ``epoch``:
+    ``_cache_invalidate`` bumps the epoch and every entry goes stale
+    in O(1) — the announce-time invalidation contract.  ``fill_round``
+    records the engine round the entry was harvested at (result age
+    in rounds, reported in the serve artifact's cache block).
+    """
+    keys: jax.Array        # [K,5] uint32 cached key limbs
+    found: jax.Array       # [K,quorum] int32 result heads (-1 pad)
+    hops: jax.Array        # [K] int32 hops the FILL paid (a hit pays 0)
+    fill_round: jax.Array  # [K] int32 engine round at fill
+    fill_epoch: jax.Array  # [K] uint32 epoch at fill (0 = never)
+    epoch: jax.Array       # []  uint32 current epoch (starts at 1)
+
+
+@partial(jax.jit, static_argnames=("cfg", "k_slots"))
+def empty_result_cache(cfg: SwarmConfig, k_slots: int) -> ResultCache:
+    """All-stale ``[k_slots]`` cache: fill epochs 0, current epoch 1 —
+    nothing can hit until the first fill."""
+    return ResultCache(
+        keys=jnp.zeros((k_slots, N_LIMBS), jnp.uint32),
+        found=jnp.full((k_slots, cfg.quorum), -1, jnp.int32),
+        hops=jnp.zeros((k_slots,), jnp.int32),
+        fill_round=jnp.zeros((k_slots,), jnp.int32),
+        fill_epoch=jnp.zeros((k_slots,), jnp.uint32),
+        epoch=jnp.uint32(1))
+
+
+def _cache_slot_of(keys: jax.Array, k_slots: int) -> jax.Array:
+    """``[A,5] -> [A]`` direct-map slot: murmur-style limb mix mod
+    ``k_slots`` (static, folds into the program)."""
+    h = keys[:, 0]
+    for j in range(1, N_LIMBS):
+        h = (h * jnp.uint32(0x9E3779B1)) ^ keys[:, j]
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> jnp.uint32(15))
+    return (h % jnp.uint32(k_slots)).astype(jnp.int32)
+
+
+def _cache_slot_np(keys: np.ndarray, k_slots: int) -> np.ndarray:
+    """Numpy twin of :func:`_cache_slot_of` (bit-identical,
+    parity-tested): the host dedupes a fill batch by SLOT before the
+    device scatter, because ``_cache_fill`` writes its five fields
+    with five independent scatters and XLA leaves the duplicate-index
+    winner implementation-defined PER SCATTER — two colliding rows
+    could otherwise land key A paired with key B's found-set."""
+    with np.errstate(over="ignore"):
+        k = keys.astype(np.uint32)
+        h = k[:, 0]
+        for j in range(1, N_LIMBS):
+            h = (h * np.uint32(0x9E3779B1)) ^ k[:, j]
+        h = h ^ (h >> np.uint32(16))
+        h = h * np.uint32(0x7FEB352D)
+        h = h ^ (h >> np.uint32(15))
+        return (h % np.uint32(k_slots)).astype(np.int64)
+
+
+def _probe_impl(cache: ResultCache, keys: jax.Array):
+    """Shared probe body (inlined into the admission jits and the
+    standalone ``_cache_probe``): one slot gather, a 5-limb compare,
+    and the epoch liveness check.  Returns ``(hit [A] bool,
+    found [A,q] i32, hops [A] i32)``."""
+    sl = _cache_slot_of(keys, cache.keys.shape[0])
+    hit = (jnp.all(cache.keys[sl] == keys, axis=1)
+           & (cache.fill_epoch[sl] == cache.epoch))
+    return hit, cache.found[sl], cache.hops[sl]
+
+
+@jax.jit
+def _cache_probe(cache: ResultCache, keys: jax.Array):
+    """Standalone probe (no admission): the ``degrade`` admission
+    policy answers rate-limited hot keys from cache only — this is
+    that read.  Pure; the cache is untouched."""
+    return _probe_impl(cache, keys)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _cache_fill(cache: ResultCache, keys: jax.Array, found: jax.Array,
+                hops: jax.Array, mask: jax.Array,
+                rnd: jax.Array) -> ResultCache:
+    """Fill harvested results (DONATED cache — single-owner like the
+    serve carry).  ``keys [M,5]`` / ``found [M,q]`` / ``hops [M]`` are
+    the harvest's completed rows, ``mask [M]`` selects real rows
+    (padding False; masked rows scatter to the drop sentinel).
+    The caller must pass SLOT-UNIQUE real rows (``fill_cache`` dedupes
+    host-side via :func:`_cache_slot_np`): the five per-field scatters
+    resolve duplicate indices independently, so colliding rows inside
+    one call could mix fields from different winners."""
+    k_slots = cache.keys.shape[0]
+    sl = jnp.where(mask, _cache_slot_of(keys, k_slots),
+                   jnp.int32(k_slots))
+    ep = jnp.broadcast_to(cache.epoch, sl.shape)
+    r32 = jnp.broadcast_to(jnp.asarray(rnd, jnp.int32), sl.shape)
+    return cache._replace(
+        keys=cache.keys.at[sl].set(keys, mode="drop"),
+        found=cache.found.at[sl].set(found, mode="drop"),
+        hops=cache.hops.at[sl].set(hops, mode="drop"),
+        fill_round=cache.fill_round.at[sl].set(r32, mode="drop"),
+        fill_epoch=cache.fill_epoch.at[sl].set(ep, mode="drop"))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _cache_invalidate(cache: ResultCache) -> ResultCache:
+    """Bump the epoch: every entry goes stale in O(1).  The
+    store-insert paths call this on announce (a cached found-set is a
+    closest-node claim the new value may have changed); epoch
+    wraparound at 2^32 bumps is out of scope for any real run."""
+    return cache._replace(epoch=cache.epoch + jnp.uint32(1))
 
 
 @partial(jax.jit, static_argnames=("cfg", "slots"))
@@ -125,6 +283,32 @@ def _scatter_rows_into(st: LookupState, new: LookupState,
         completed_round=st.completed_round.at[sl].set(-1, mode="drop"))
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))
+def _admit_cached(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+                  cache: ResultCache, keys: jax.Array,
+                  slots: jax.Array, origins: jax.Array,
+                  rnd: jax.Array):
+    """:func:`_admit` with the result cache consulted INSIDE the
+    admission program: rows whose key hits a live cache entry are
+    redirected to the drop sentinel — they never occupy a slot, never
+    solicit anybody, and complete in zero rounds; misses scatter
+    exactly like :func:`_admit` (same ``init_impl`` seed exchange, so
+    the cache-off engine is a strict subset program).  Both the serve
+    state AND the cache are donated (single-owner carries); the cache
+    passes through unchanged — fills are a harvest-side concern
+    (:func:`_cache_fill`), admission only reads.  Returns
+    ``(state, cache, hit [A], hit_found [A,q], hit_hops [A])``; the
+    host reads the hit row right after dispatch (its only per-
+    admission sync, paid only when the cache is on)."""
+    c = st.done.shape[0]
+    hit, h_found, h_hops = _probe_impl(cache, keys)
+    new = init_impl(swarm.ids, _local_respond(swarm, cfg), cfg, keys,
+                    origins)
+    eff = jnp.where(hit, jnp.int32(c), slots)
+    st = _scatter_rows_into(st, new, eff, rnd)
+    return st, cache, hit, h_found, h_hops
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def _snapshot(swarm: Swarm, cfg: SwarmConfig, st: LookupState):
     """The per-burst harvest readback: done mask, hops, lifecycle rows
@@ -151,12 +335,32 @@ def _expire_slots(st: LookupState, slots: jax.Array) -> LookupState:
 class ServeEngine:
     """Single-chip serve engine: admit / step / snapshot over one
     resident ``[slots]`` state.  ``admit_cap`` fixes the admission
-    micro-batch width (one compiled admit program)."""
+    micro-batch width (one compiled admit program).
+
+    ``cache_slots > 0`` attaches the device hot-key result cache
+    (:class:`ResultCache`): admissions go through
+    :meth:`admit_probed` (probe fused into the admission jit, hits
+    complete instantly without a slot), harvested completions fill
+    via :meth:`fill_cache`, and announces invalidate via
+    :meth:`invalidate_cache`.  ``cache_slots = 0`` (default) keeps
+    every program byte-identical to the pre-cache engine — the cache
+    is a pure overlay (proven in tests/test_serve.py)."""
 
     def __init__(self, swarm: Swarm, cfg: SwarmConfig, slots: int,
-                 admit_cap: int | None = None):
+                 admit_cap: int | None = None, cache_slots: int = 0):
         self.swarm, self.cfg, self.slots = swarm, cfg, slots
         self.admit_cap = min(slots, admit_cap or min(slots, 512))
+        if cache_slots < 0:
+            raise ValueError(f"cache_slots must be >= 0, got "
+                             f"{cache_slots}")
+        self.cache_slots = cache_slots
+        self.cache = (empty_result_cache(cfg, cache_slots)
+                      if cache_slots else None)
+        # Test hook: False keeps the cache permanently cold (every
+        # probe misses) — the pure-overlay equivalence proof runs the
+        # cache-ON programs against the cache-off engine with fills
+        # disabled, so the two must be bit-identical end to end.
+        self.cache_fill_enabled = True
 
     def empty(self) -> LookupState:
         return empty_serve_state(self.cfg, self.slots)
@@ -181,6 +385,78 @@ class ServeEngine:
         return _swarm._lookup_step_d(self.swarm, self.cfg, st,
                                      dev_i32(rnd))
 
+    def admit_probed(self, st, keys, slots, key, rnd):
+        """Cache-consulted admission: like :meth:`admit` but rows
+        whose key hits the cache never occupy their slot.  Returns
+        ``(state, hit, hit_found, hit_hops)`` with the hit row already
+        on the host (one small readback per admission — the cache-on
+        loop's extra sync; the cache-off loop pays none)."""
+        origins = _sample_origins(key, self.swarm.alive, keys.shape[0])
+        st, self.cache, hit, found, hops = _admit_cached(
+            self.swarm, self.cfg, st, self.cache, keys, slots, origins,
+            dev_i32(rnd))
+        h, f, hp = jax.device_get((hit, found, hops))
+        return st, h, f, hp
+
+    def probe_cache(self, keys):
+        """Host-visible cache read (the ``degrade`` policy's path):
+        ``(hit, found, hops)`` numpy rows for ``keys [A,5]``."""
+        return jax.device_get(_cache_probe(self.cache, keys))
+
+    def fill_cache(self, keys_np, found_np, hops_np, rnd) -> int:
+        """Fill harvested completions into the cache, padded to ONE
+        compiled width (``admit_cap``).  Rows colliding on a cache
+        slot are deduped HOST-side first (last writer wins — see
+        :func:`_cache_slot_np` for why the device scatter must see
+        unique slots), and batches wider than the cap truncate — a
+        fill is best-effort (dropped rows' keys stay cache-cold and
+        re-fill at their next completion).  Returns the rows actually
+        filled."""
+        if self.cache is None or not self.cache_fill_enabled:
+            return 0
+        keys_np = np.asarray(keys_np, np.uint32).reshape(-1, N_LIMBS)
+        found_np = np.asarray(found_np)
+        if len(keys_np):
+            # Never cache a NEGATIVE result: an empty found head is a
+            # transient (a lookup racing churn), and pinning it would
+            # answer every follower "not found" in zero rounds for a
+            # whole epoch where the cache-off engine would retry and
+            # likely succeed.
+            ok = found_np[:, 0] >= 0
+            keys_np = keys_np[ok]
+            found_np = found_np[ok]
+            hops_np = np.asarray(hops_np)[ok]
+        if len(keys_np):
+            sl = _cache_slot_np(keys_np, self.cache_slots)
+            # Keep the LAST occurrence per slot (the freshest result).
+            _, last = np.unique(sl[::-1], return_index=True)
+            pick = np.sort(len(sl) - 1 - last)
+            keys_np = keys_np[pick]
+            found_np = found_np[pick]
+            hops_np = np.asarray(hops_np)[pick]
+        a = self.admit_cap
+        m = min(len(keys_np), a)
+        keys = np.zeros((a, N_LIMBS), np.uint32)
+        found = np.full((a, self.cfg.quorum), -1, np.int32)
+        hops = np.zeros((a,), np.int32)
+        mask = np.zeros((a,), bool)
+        keys[:m] = keys_np[:m]
+        found[:m] = found_np[:m]
+        hops[:m] = hops_np[:m]
+        mask[:m] = True
+        self.cache = _cache_fill(
+            self.cache, jnp.asarray(keys), jnp.asarray(found),
+            jnp.asarray(hops), jnp.asarray(mask), dev_i32(rnd))
+        return m
+
+    def invalidate_cache(self) -> None:
+        """Announce-side TTL: the store-insert paths bump the device
+        epoch the probe checks (one O(1) scalar bump retires every
+        entry).  The soak engine's write flush calls this; a no-op
+        without a cache."""
+        if self.cache is not None:
+            self.cache = _cache_invalidate(self.cache)
+
     def expire(self, st, slots):
         return _expire_slots(st, slots)
 
@@ -196,8 +472,9 @@ class ShardedServeEngine(ServeEngine):
 
     def __init__(self, swarm: Swarm, cfg: SwarmConfig, slots: int,
                  mesh, capacity_factor: float = 2.0,
-                 admit_cap: int | None = None):
-        super().__init__(swarm, cfg, slots, admit_cap)
+                 admit_cap: int | None = None, cache_slots: int = 0):
+        super().__init__(swarm, cfg, slots, admit_cap,
+                         cache_slots=cache_slots)
         from ..parallel.mesh import AXIS
         self.mesh, self.capacity_factor = mesh, capacity_factor
         d = mesh.shape[AXIS]
@@ -214,6 +491,17 @@ class ShardedServeEngine(ServeEngine):
                                    self.mesh, self.capacity_factor)
         return _scatter_admission(st, new, slots, dev_i32(rnd))
 
+    def admit_probed(self, st, keys, slots, key, rnd):
+        # Same routed init; the probe rides the scatter program
+        # (_scatter_admission_cached) against the REPLICATED cache.
+        from ..parallel.sharded import _sharded_lookup_init
+        new = _sharded_lookup_init(self.swarm, self.cfg, keys, key,
+                                   self.mesh, self.capacity_factor)
+        st, self.cache, hit, found, hops = _scatter_admission_cached(
+            st, self.cache, new, slots, dev_i32(rnd))
+        h, f, hp = jax.device_get((hit, found, hops))
+        return st, h, f, hp
+
     def step(self, st, rnd):
         from ..parallel.sharded import _sharded_lookup_step
         return _sharded_lookup_step(self.swarm, self.cfg, st, self.mesh,
@@ -225,6 +513,26 @@ class ShardedServeEngine(ServeEngine):
 def _scatter_admission(st: LookupState, new: LookupState,
                        slots: jax.Array, rnd: jax.Array) -> LookupState:
     return _scatter_rows_into(st, new, slots, rnd)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_admission_cached(st: LookupState, cache: ResultCache,
+                              new: LookupState, slots: jax.Array,
+                              rnd: jax.Array):
+    """The sharded twin of :func:`_admit_cached`: the routed init
+    already ran (``_sharded_lookup_init`` — its seed exchange must
+    stay uncapped and shard-local), so the probe keys are the init
+    rows' own ``targets``.  The cache is REPLICATED across the mesh
+    like the trace's pmax fields: fills are computed from replicated
+    host-side inputs (every device runs the same fill on the same
+    data), so no psum is needed to keep the copies identical — GSPMD
+    gathers the sharded probe indices against the replicated cache
+    and the hit row comes back replicated."""
+    c = st.done.shape[0]
+    hit, h_found, h_hops = _probe_impl(cache, new.targets)
+    eff = jnp.where(hit, jnp.int32(c), slots)
+    st = _scatter_rows_into(st, new, eff, rnd)
+    return st, cache, hit, h_found, h_hops
 
 
 def poisson_zipf_events(rate: float, duration: float, key_pool: int,
@@ -277,6 +585,112 @@ def poisson_zipf_events(rate: float, duration: float, key_pool: int,
     return ts, pool[draw], klass
 
 
+class AdmissionControl:
+    """Per-class token-bucket admission policy — the host half of the
+    reference's inbound rate limiting (``rate_limiter.h`` + the
+    1,600 req/s global cap, network_engine.h:462), applied where this
+    engine admits: the slot plane's admission step.
+
+    One :class:`~opendht_tpu.utils.rate_limiter.TokenBucket` per
+    request class (the serve workload's ``hot``/``cold`` — the
+    per-client axis this harness models), each accruing ``rate``
+    tokens/s up to ``burst``.  A request whose class bucket is dry is
+    handled per ``policy``:
+
+    * ``shed``    — dropped and booked as ``shed`` in the lifecycle
+      accounting (the reference's behavior: over-quota packets are
+      dropped, the node stays up).  Queue overflow past the overload
+      bound ALSO sheds under this policy instead of raising
+      :class:`ServeOverloadError` — graceful degradation replaces
+      exit 2.
+    * ``queue``   — waits in the admission queue for tokens (head-of-
+      line; the overload guard still applies, so a persistently
+      over-rate stream eventually raises — that IS this policy's
+      contract).
+    * ``degrade`` — answered from the result cache only: a hit
+      completes (booked as admitted + completed + cache hit), a miss
+      is shed.  Over-quota traffic costs one cache probe, never a
+      lookup slot.
+    """
+
+    POLICIES = ("shed", "queue", "degrade")
+
+    def __init__(self, rate: float, burst: float | None = None,
+                 policy: str = "shed"):
+        from ..utils.rate_limiter import TokenBucket
+        if policy not in self.POLICIES:
+            raise ValueError(f"admission policy must be one of "
+                             f"{self.POLICIES}, got {policy!r}")
+        if rate <= 0:
+            raise ValueError(f"admission rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None \
+            else max(1.0, self.rate)
+        if self.burst < 1.0:
+            # Validate HERE, not at the first lazy TokenBucket deep
+            # inside the serve loop (after minutes of swarm build).
+            raise ValueError(f"admission burst must be >= 1, got "
+                             f"{self.burst}")
+        self.policy = policy
+        self._tb = TokenBucket                 # class, for lazy buckets
+        self._buckets: dict = {}
+
+    def allow(self, klass, now: float) -> bool:
+        b = self._buckets.get(klass)
+        if b is None:
+            b = self._buckets[klass] = self._tb(self.rate, self.burst)
+        return b.limit(now)
+
+
+def measure_round_wall(swarm: Swarm, cfg: SwarmConfig,
+                       slots: int = 1024, rounds: int = 6) -> float:
+    """Measured per-round wall of a FULLY-OCCUPIED ``[slots]`` serve
+    state (warmed first — compile never books as round wall): the
+    input the slot autotuner sizes from.  One probe engine, ``rounds``
+    back-to-back steps, one barrier."""
+    eng = ServeEngine(swarm, cfg, slots=slots, admit_cap=slots)
+    warm_serve_engine(eng)
+    st = eng.empty()
+    keys = jax.random.bits(jax.random.PRNGKey(17), (slots, N_LIMBS),
+                           jnp.uint32)
+    st = eng.admit(st, keys, jnp.arange(slots, dtype=jnp.int32),
+                   jax.random.PRNGKey(18), 0)
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        st = eng.step(st, r)
+    jax.block_until_ready(st)
+    return (time.perf_counter() - t0) / rounds
+
+
+def autotune_serve_slots(cfg: SwarmConfig, arrival_rate: float,
+                         round_wall_s: float,
+                         target_occupancy: float = 0.5,
+                         floor: int = 128,
+                         ceil: int = 65536) -> int:
+    """Size the slot plane from arrival rate × measured round wall —
+    the PR-7 0.15-occupancy finding (1,024 slots for a load that
+    needed ~150) turned into arithmetic.
+
+    Little's law: concurrent in-flight work ``D = rate × service
+    time``, with service time ≈ the calibrated convergence depth
+    (``burst_schedule`` rounds, +1 for the admission round) × the
+    measured round wall.  Slots = the next power of two covering
+    ``D / target_occupancy`` (the headroom keeping queueing off the
+    admission path when arrivals burst), clamped to
+    ``[floor, ceil]``.  Pure arithmetic — the measurement half is
+    :func:`measure_round_wall` — so it unit-tests without a clock."""
+    if arrival_rate <= 0 or round_wall_s <= 0:
+        raise ValueError("arrival_rate and round_wall_s must be > 0")
+    if not 0.0 < target_occupancy <= 1.0:
+        raise ValueError(f"target_occupancy must be in (0, 1], got "
+                         f"{target_occupancy}")
+    service_s = (burst_schedule(cfg) + 1) * round_wall_s
+    demand = arrival_rate * service_s / target_occupancy
+    slots = 1 << max(0, math.ceil(math.log2(max(1.0, demand))))
+    return int(min(ceil, max(floor, slots)))
+
+
 def warm_serve_engine(engine: ServeEngine) -> None:
     """Compile admit/step/snapshot/expire OFF the serve clock (compile
     time must never masquerade as queueing delay).  Shared by
@@ -299,6 +713,24 @@ def warm_serve_engine(engine: ServeEngine) -> None:
     # (a request aging past max_steps), where a fresh jit would land
     # inside a burst wall mark and read as tail latency.
     engine.expire(st, jnp.full((a_cap,), c, jnp.int32))
+    if engine.cache is not None:
+        # Cache-on programs warm too (probe-fused admit, the fill at
+        # its one padded width, the standalone degrade probe).  The
+        # warm fill is an all-masked batch: compiles the program,
+        # writes nothing — the cache stays cold, which the
+        # pure-overlay equivalence proof depends on.  Cache-off
+        # engines skip this block entirely, so the warmed program set
+        # (and the soak loop's bit-identity contract) is unchanged.
+        st2 = engine.empty()
+        st2, _h, _f, _hp = engine.admit_probed(
+            st2, warm_keys, warm_slots, jax.random.PRNGKey(0), 0)
+        fills_on = engine.cache_fill_enabled
+        engine.cache_fill_enabled = True
+        engine.fill_cache(np.zeros((0, N_LIMBS), np.uint32),
+                          np.zeros((0, engine.cfg.quorum), np.int32),
+                          np.zeros((0,), np.int32), 0)
+        engine.cache_fill_enabled = fills_on
+        engine.probe_cache(warm_keys)
 
 
 def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
@@ -306,7 +738,8 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
                     duration: float | None = None,
                     overload_queue_factor: int = 8,
                     drain_round_cap: int | None = None,
-                    clock=None, sleep=None) -> dict:
+                    clock=None, sleep=None,
+                    admission: AdmissionControl | None = None) -> dict:
     """Drive the serve engine against an open-loop arrival schedule.
 
     ``arrival_ts``/``keys``(/``klass``) come from
@@ -331,7 +764,24 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
     clock makes the whole loop — admission decisions, burst marks, the
     reconstructed latency samples — a pure function of the schedule,
     which is how ``tests/test_soak.py`` proves the soak loop's
-    maintenance-off path BIT-identical to this one.
+    maintenance-off path BIT-identical to this one.  The cache and
+    admission-control paths below are strictly additive: with the
+    cache off and no admission policy, the loop makes the exact same
+    program dispatches AND the exact same ``clock()``/``sleep()`` call
+    sequence as before this round — the overlay proofs in
+    tests/test_serve.py lean on both.
+
+    With ``engine.cache`` attached, admissions go through the
+    probe-fused admit: rows that hit complete instantly (zero service
+    rounds, zero slots, latency = queueing delay, floored at the
+    admission wall like every completion), misses fall through
+    unchanged, and harvested completions fill the cache for their
+    followers.  ``admission`` applies the per-class token buckets at
+    the admission step (policy ``shed`` / ``queue`` / ``degrade`` —
+    see :class:`AdmissionControl`); ``shed`` also converts the
+    overload guard from exit-2 to graceful shedding, so an overload
+    scenario ends with ``shed`` requests accounted instead of a dead
+    bench.
 
     Returns the serve report dict (see the module docstring for the
     latency reconstruction); per-request arrays are ordered by
@@ -341,6 +791,12 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
     sleep = sleep or time.sleep
     cfg, c = engine.cfg, engine.slots
     a_cap = engine.admit_cap
+    use_cache = getattr(engine, "cache", None) is not None
+    if admission is not None and admission.policy == "degrade" \
+            and not use_cache:
+        raise ValueError("admission policy 'degrade' answers from the "
+                         "result cache — build the engine with "
+                         "cache_slots > 0")
     keys = np.asarray(keys)        # host-side: see poisson_zipf_events
     r_total = len(arrival_ts)
     if klass is None:
@@ -370,6 +826,7 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
     queue_depths = []
     occ_samples = []
     admitted = completed = expired = 0
+    shed = cache_hits = cache_misses = degraded_hits = 0
     drain_rounds = 0
     overload = overload_queue_factor * c
 
@@ -380,25 +837,83 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
             queue.append(next_ev)
             next_ev += 1
         if len(queue) > overload:
-            raise ServeOverloadError(
-                f"serve overload: admission queue reached {len(queue)} "
-                f"requests (> {overload_queue_factor} x {c} slots) at "
-                f"t={now:.2f}s — the arrival rate exceeds what this "
-                f"slot capacity sustains on this machine; lower "
-                f"--arrival-rate or raise --serve-slots")
+            if admission is not None \
+                    and admission.policy in ("shed", "degrade"):
+                # Graceful degradation: shed the NEWEST arrivals past
+                # the bound (FIFO fairness for the older queue) and
+                # keep serving — the reference drops over-quota
+                # packets, it does not exit.  (``degrade`` sheds here
+                # too: queue overflow is beyond what cache probes can
+                # absorb; only ``queue`` keeps the hard error.)
+                over = len(queue) - overload
+                del queue[-over:]
+                shed += over
+            else:
+                raise ServeOverloadError(
+                    f"serve overload: admission queue reached "
+                    f"{len(queue)} requests (> {overload_queue_factor}"
+                    f" x {c} slots) at t={now:.2f}s — the arrival "
+                    f"rate exceeds what this slot capacity sustains "
+                    f"on this machine; lower --arrival-rate, raise "
+                    f"--serve-slots, or shed with --admission shed")
         if now > hard_wall:
-            raise ServeOverloadError(
-                f"serve overload: run exceeded the {hard_wall:.0f}s "
-                f"hard wall ({r_total - next_ev + len(queue)} requests "
-                f"not yet admitted, {len(occupied)} in flight) — the "
-                f"arrival rate exceeds serve capacity on this machine")
+            if admission is not None \
+                    and admission.policy in ("shed", "degrade"):
+                # The shedding policies never exit 2: a run that blew
+                # the hard wall sheds its ENTIRE backlog (queued and
+                # not-yet-arrived — they would only queue behind it)
+                # and falls through to drain the in-flight work, so
+                # the report ends with honest sheds instead of a dead
+                # bench.  Booked before the admission step: nothing
+                # from the backlog is admitted after the wall.
+                shed += len(queue) + (r_total - next_ev)
+                queue.clear()
+                next_ev = r_total
+            else:
+                raise ServeOverloadError(
+                    f"serve overload: run exceeded the "
+                    f"{hard_wall:.0f}s hard wall "
+                    f"({r_total - next_ev + len(queue)} requests not "
+                    f"yet admitted, {len(occupied)} in flight) — the "
+                    f"arrival rate exceeds serve capacity on this "
+                    f"machine")
         queue_depths.append(len(queue))
 
-        # --- admit one micro-batch into recycled slots
-        m = min(len(queue), len(free), a_cap)
-        if m:
+        # --- admission control: per-class token buckets gate which
+        # queued requests may take a slot this iteration.
+        cap = min(len(free), a_cap)
+        degr: list[int] = []
+        if admission is None:
+            m = min(len(queue), cap)
             take = queue[:m]
             del queue[:m]
+        else:
+            # Every examined request is consumed (taken / shed /
+            # degraded) except under the queue policy, which stops at
+            # the first dry head — so the decisions cover a strict
+            # PREFIX and one slice-delete keeps this O(examined),
+            # like the admission-None path (queue.pop(0) per request
+            # would be O(queue) each on the firehose leg's pinned
+            # 2k-deep queue).
+            take = []
+            qi = 0
+            while qi < len(queue) and len(take) < cap \
+                    and len(degr) < a_cap:
+                ri = queue[qi]
+                if admission.allow(str(klass[ri]), now):
+                    take.append(ri)
+                elif admission.policy == "shed":
+                    shed += 1
+                elif admission.policy == "degrade":
+                    degr.append(ri)
+                else:           # queue: head-of-line waits for tokens
+                    break
+                qi += 1
+            del queue[:qi]
+            m = len(take)
+
+        # --- admit one micro-batch into recycled slots
+        if m:
             slots_np = np.full(a_cap, c, np.int32)
             keys_np = np.zeros((a_cap, N_LIMBS), np.uint32)
             for j, ri in enumerate(take):
@@ -407,11 +922,60 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
                 occupied[slot] = ri
                 admit_wall[ri] = now
             keys_np[:m] = keys[np.asarray(take)]
-            st = engine.admit(st, jnp.asarray(keys_np),
-                              jnp.asarray(slots_np),
-                              jax.random.fold_in(key, adm_i), rnd)
+            if use_cache:
+                # Probe-fused admission: the hit row comes back with
+                # the dispatch (the cache-on loop's one extra small
+                # sync).  Hit rows never occupied their slot — the
+                # scatter dropped them — so they free immediately and
+                # complete AT the admission wall: latency is pure
+                # queueing delay, service is zero rounds / zero hops.
+                st, hit, h_found, h_hops = engine.admit_probed(
+                    st, jnp.asarray(keys_np), jnp.asarray(slots_np),
+                    jax.random.fold_in(key, adm_i), rnd)
+                for j, ri in enumerate(take):
+                    if not hit[j]:
+                        cache_misses += 1
+                        continue
+                    slot = int(slots_np[j])
+                    occupied.pop(slot)
+                    free.append(slot)
+                    rec_req.append(ri)
+                    rec_lat.append(max(0.0,
+                                       now - float(arrival_ts[ri])))
+                    rec_hops.append(0)
+                    rec_rounds.append(0)
+                    rec_found.append(int(h_found[j, 0]) >= 0)
+                    completed += 1
+                    cache_hits += 1
+            else:
+                st = engine.admit(st, jnp.asarray(keys_np),
+                                  jnp.asarray(slots_np),
+                                  jax.random.fold_in(key, adm_i), rnd)
             adm_i += 1
             admitted += m
+
+        # --- degrade policy: over-quota requests get one cache probe
+        # — a hit answers (admitted + completed, zero rounds), a miss
+        # sheds.  Costs no slot, no lookup round.
+        if degr:
+            dk = np.zeros((a_cap, N_LIMBS), np.uint32)
+            dk[:len(degr)] = keys[np.asarray(degr)]
+            d_hit, d_found, _d_hops = engine.probe_cache(
+                jnp.asarray(dk))
+            for j, ri in enumerate(degr):
+                if d_hit[j]:
+                    rec_req.append(ri)
+                    rec_lat.append(max(0.0,
+                                       now - float(arrival_ts[ri])))
+                    rec_hops.append(0)
+                    rec_rounds.append(0)
+                    rec_found.append(int(d_found[j, 0]) >= 0)
+                    admitted += 1
+                    completed += 1
+                    cache_hits += 1
+                    degraded_hits += 1
+                else:
+                    shed += 1
 
         draining = next_ev >= r_total and not queue
         if draining and not occupied:
@@ -436,6 +1000,7 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
         marks_w.append(w)
         occ_samples.append(len(occupied) / c)
 
+        fill_k, fill_f, fill_h = [], [], []
         for slot in [s for s, _ in occupied.items() if done[s]]:
             ri = occupied.pop(slot)
             free.append(slot)
@@ -463,6 +1028,16 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
             rec_rounds.append(cr - int(adm_r[slot]) + 1)
             rec_found.append(int(found[slot, 0]) >= 0)
             completed += 1
+            if use_cache:
+                fill_k.append(keys[ri])
+                fill_f.append(found[slot])
+                fill_h.append(int(hops[slot]))
+        if use_cache and fill_k:
+            # Fill the harvest's completions (the miss path's results)
+            # so their followers hit: one donated fixed-width fill
+            # dispatch, no sync.
+            engine.fill_cache(np.asarray(fill_k), np.asarray(fill_f),
+                              np.asarray(fill_h), rnd)
 
         # --- expiry: rows past their round budget (the batch engine's
         # max_steps cap) retire instead of squatting on their slot.
@@ -494,6 +1069,12 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
         "expired": expired,
         "in_flight": len(occupied),
         "never_admitted": len(queue) + (r_total - next_ev),
+        "shed": shed,
+        "cache_hits": cache_hits,
+        "cache_misses": cache_misses,
+        "degraded_hits": degraded_hits,
+        "cache_slots": getattr(engine, "cache_slots", 0),
+        "admission_policy": admission.policy if admission else None,
         "rounds": rnd,
         "elapsed_s": elapsed,
         "sustained_rps": completed / elapsed if elapsed > 0 else 0.0,
@@ -515,7 +1096,8 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
 
 
 def closed_loop_replay(swarm: Swarm, cfg: SwarmConfig,
-                       targets: jax.Array, key: jax.Array
+                       targets: jax.Array, key: jax.Array,
+                       engine: ServeEngine | None = None
                        ) -> tuple[LookupResult, LookupState]:
     """Feed a fixed batch through the serve engine's admit/step path
     (slots = L, everything admitted at round 0) and run to completion.
@@ -528,9 +1110,24 @@ def closed_loop_replay(swarm: Swarm, cfg: SwarmConfig,
     asserted in tests/test_serve.py, mirroring test_compaction.py's
     seed-identity pattern.  Returns ``(LookupResult, final state)`` so
     callers can inspect the lifecycle rows.
+
+    ``engine`` overrides the default local engine — passing a
+    :class:`ShardedServeEngine` (slots = admit_cap = L) replays
+    through the ROUTED admit/step and must be bit-identical to
+    ``sharded_lookup(..., compact=False)`` for the same key: the
+    routed init folds the key per shard exactly like the burst
+    formulation's init body, and the routed step is the same donated
+    program — the slot-recycling admission equivalence, proven on the
+    mesh.  The replay always uses the PLAIN admit (never the cache
+    probe): replay semantics are the batch engine's.
     """
     l = targets.shape[0]
-    eng = ServeEngine(swarm, cfg, slots=l, admit_cap=l)
+    eng = engine if engine is not None \
+        else ServeEngine(swarm, cfg, slots=l, admit_cap=l)
+    if eng.slots != l or eng.admit_cap < l:
+        raise ValueError(f"closed-loop replay needs slots == L == "
+                         f"admit_cap; engine has slots={eng.slots}, "
+                         f"admit_cap={eng.admit_cap} for L={l}")
     st = eng.empty()
     st = eng.admit(st, targets, jnp.arange(l, dtype=jnp.int32), key, 0)
     rnd = 0

@@ -329,10 +329,21 @@ class SoakEngine:
                  index=None, scan_key_fn=None,
                  admit_cap: int | None = None,
                  soak_cfg: SoakConfig | None = None,
-                 maint_key: jax.Array | None = None):
+                 maint_key: jax.Array | None = None,
+                 cache_slots: int = 0):
         self.swarm, self.cfg = swarm, cfg
+        # ``cache_slots`` PROVISIONS the serve engine's hot-key result
+        # cache (and arms the write-flush epoch invalidation below)
+        # for callers that drive admissions through
+        # ``serve.admit_probed``.  The stock :func:`soak_open_loop`
+        # still admits through the plain path and does NOT consult the
+        # cache yet — probing + hit bookkeeping inside the soak loop
+        # (hits must skip the work-class plane too) is the ROADMAP #1
+        # follow-up.  0 (default) keeps the engine byte-identical to
+        # the pre-cache one.
         self.serve = ServeEngine(swarm, cfg, slots,
-                                 admit_cap=admit_cap)
+                                 admit_cap=admit_cap,
+                                 cache_slots=cache_slots)
         self.scfg, self.store = scfg, store
         self.mon = monitor
         self.index = index
@@ -882,6 +893,11 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
             jnp.asarray(wk), jnp.asarray(wv), jnp.asarray(ws),
             dev_u32(soak.store_now))
         soak.store_now += 1
+        # The store-insert path bumps the result-cache epoch: a cached
+        # found-set is a closest-node claim the announce may have
+        # changed (the cache's TTL/invalidation contract; a no-op
+        # without a cache).
+        soak.serve.invalidate_cache()
         wbuf = jnp.full((wf, cfg.quorum), -1, jnp.int32)
         wpend = []
         write_flushes += 1

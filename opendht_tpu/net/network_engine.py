@@ -52,7 +52,8 @@ from ..core.value import Query, Value
 from ..utils.infohash import InfoHash
 from ..utils.logger import NONE, Logger
 from ..utils.metrics import MetricsRegistry
-from ..utils.rate_limiter import RateLimiter, make_rate_limiter
+from ..utils.rate_limiter import (RateLimiter, TokenBucket,
+                                  make_rate_limiter)
 from ..utils.sockaddr import AF_INET, AF_INET6, SockAddr
 from .request import Request, RequestState
 from .transport import DatagramTransport
@@ -172,8 +173,12 @@ class NetworkEngine:
         self._sock_seq = self.rng.randrange(1 << 16)
 
         self.rate_limiter = make_rate_limiter(MAX_REQUESTS_PER_SEC)
-        # Keyed by host string (IPv4) or 8-byte packed /64 prefix (IPv6).
-        self.ip_limiters: Dict[object, RateLimiter] = {}
+        # Keyed by host string (IPv4) or 8-byte packed /64 prefix
+        # (IPv6).  Token buckets, not sliding windows: the map grows
+        # one entry per distinct sender, so per-sender state must be
+        # O(1) floats, not a deque of up to 200 timestamps — same
+        # steady-state admit rate (utils/rate_limiter.py).
+        self.ip_limiters: Dict[object, RateLimiter | TokenBucket] = {}
         self.blacklist: Dict[SockAddr, float] = {}
 
         self.partial_messages: Dict[bytes, PartialMessage] = {}
@@ -514,7 +519,7 @@ class NetworkEngine:
         lim = self.ip_limiters.get(key)
         if lim is None:
             lim = self.ip_limiters[key] = make_rate_limiter(
-                MAX_REQUESTS_PER_SEC_PER_IP)
+                MAX_REQUESTS_PER_SEC_PER_IP, kind="token-bucket")
         return lim.limit(now) and self.rate_limiter.limit(now)
 
     def _deliver_assembled(self, pm: PartialMessage) -> None:

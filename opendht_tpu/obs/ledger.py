@@ -83,7 +83,12 @@ ENTRY_POINTS: tuple = (
     ("opendht_tpu.models.swarm", "_finalize", ()),
     ("opendht_tpu.models.swarm", "_finalize_scattered", ()),
     ("opendht_tpu.models.serve", "_admit", (2,)),
+    ("opendht_tpu.models.serve", "_admit_cached", (2, 3)),
     ("opendht_tpu.models.serve", "_scatter_admission", (0,)),
+    ("opendht_tpu.models.serve", "_scatter_admission_cached", (0, 1)),
+    ("opendht_tpu.models.serve", "_cache_probe", ()),
+    ("opendht_tpu.models.serve", "_cache_fill", (0,)),
+    ("opendht_tpu.models.serve", "_cache_invalidate", (0,)),
     ("opendht_tpu.models.serve", "_snapshot", ()),
     ("opendht_tpu.models.serve", "_expire_slots", (0,)),
     ("opendht_tpu.models.soak", "_scatter_wclass", (0,)),

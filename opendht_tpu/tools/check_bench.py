@@ -121,6 +121,17 @@ def check_bench_rows(cur: dict, base: dict,
                 f"latency_p99_s {p_cur} above {max_p99_ratio:.1f}x "
                 f"recorded baseline {p_base} (ceiling "
                 f"{max_p99_ratio * p_base:.4f}s)")
+        # Cache-on serve rows also hold their hit fraction: a row
+        # that kept its rate by hammering the slot plane because the
+        # cache stopped hitting must not gate green.  Same-platform
+        # (hit rate depends on completion timing, which is a machine
+        # property) with a 0.9x floor — the Zipf schedule is seeded,
+        # so the band is run noise, not workload variance.
+        c_cur, c_base = cur.get("cache_hit_frac"), base.get(
+            "cache_hit_frac")
+        if c_cur is not None and c_base and c_cur < 0.9 * c_base:
+            errs.append(f"cache_hit_frac {c_cur} below 90% of "
+                        f"recorded baseline {c_base}")
     else:
         print(f"check_bench: rate comparison SKIPPED — platform "
               f"{cur.get('platform')!r} vs baseline "

@@ -324,9 +324,12 @@ def check_serve_obj(obj: dict) -> List[str]:
     in_flight = life.get("in_flight")
     expired = life.get("expired", 0)
     never = life.get("never_admitted", 0)
+    shed = life.get("shed", 0)
+    cache_hits = life.get("cache_hits", 0)
     for name, v in (("admitted", admitted), ("completed", completed),
                     ("in_flight", in_flight), ("expired", expired),
-                    ("never_admitted", never)):
+                    ("never_admitted", never), ("shed", shed),
+                    ("cache_hits", cache_hits)):
         if not (_num(v) and v >= 0):
             errs.append(f"lifecycle {name} invalid: {v!r}")
     if errs:
@@ -335,8 +338,51 @@ def check_serve_obj(obj: dict) -> List[str]:
         errs.append(f"lifecycle does not conserve: admitted {admitted} "
                     f"!= completed {completed} + in_flight {in_flight} "
                     f"+ expired {expired}")
+    if cache_hits > completed:
+        errs.append(f"lifecycle cache_hits {cache_hits} > completed "
+                    f"{completed} — a hit IS a completion")
     if completed == 0:
         errs.append("no request completed — nothing to stand behind")
+
+    # Cache block (ISSUE 12): every admission is booked as exactly one
+    # of hit or miss, hits are conserved against the lifecycle plane,
+    # and every hit's service-rounds sample lands in the FIRST bucket
+    # — a hit that took a lookup round is not a hit.
+    cache = obj.get("cache")
+    if cache is None and cache_hits:
+        errs.append(f"lifecycle books {cache_hits} cache_hits but the "
+                    f"artifact has no cache block")
+    if cache is not None:
+        hits = cache.get("hits")
+        misses = cache.get("misses")
+        degr = cache.get("degraded_hits", 0)
+        for name, v in (("hits", hits), ("misses", misses),
+                        ("degraded_hits", degr)):
+            if not (_num(v) and v >= 0):
+                errs.append(f"cache {name} invalid: {v!r}")
+                return errs
+        if hits + misses != admitted:
+            errs.append(f"cache does not conserve: hits {hits} + "
+                        f"misses {misses} != admitted {admitted} "
+                        f"(each admission is exactly one of the two)")
+        if hits != cache_hits:
+            errs.append(f"cache hits {hits} != lifecycle cache_hits "
+                        f"{cache_hits}")
+        if degr > hits:
+            errs.append(f"cache degraded_hits {degr} > hits {hits}")
+        hh = cache.get("hit_rounds_histogram") or {}
+        h_counts = hh.get("counts") or []
+        if not h_counts:
+            errs.append("cache block missing hit_rounds_histogram")
+        else:
+            if sum(h_counts) != hits:
+                errs.append(f"hit_rounds_histogram holds "
+                            f"{sum(h_counts)} samples for {hits} hits")
+            if h_counts[0] != hits:
+                errs.append(
+                    f"hit_rounds_histogram first bucket holds "
+                    f"{h_counts[0]} of {hits} hits — a cache hit must "
+                    f"complete in zero service rounds")
 
     bounds = hist.get("bounds") or []
     counts = hist.get("counts") or []
@@ -415,10 +461,19 @@ def check_serve_obj(obj: dict) -> List[str]:
                         f"with completed/elapsed = {want:.1f}")
     df = bench.get("done_frac")
     if _num(df) and admitted:
-        want_df = completed / (admitted + never)
+        # Offered = everything the schedule produced: admitted + shed
+        # (dropped by admission control / overload shedding) + never
+        # admitted.  Shedding must show up in done_frac — a row that
+        # sheds 90% of traffic and reports done_frac 1.0 is a lie.
+        want_df = completed / (admitted + never + shed)
         if abs(df - want_df) > 1e-6:
             errs.append(f"bench done_frac {df} != completed/offered "
                         f"{want_df:.6f}")
+    for name, v in (("shed", shed), ("cache_hits", cache_hits)):
+        row_v = bench.get(name)
+        if row_v is not None and row_v != v:
+            errs.append(f"bench row {name} {row_v} != lifecycle "
+                        f"{name} {v}")
     occ = bench.get("slot_occupancy_frac")
     if occ is not None and not (_num(occ) and 0.0 <= occ <= 1.0):
         errs.append(f"slot_occupancy_frac not a fraction: {occ!r}")

@@ -1467,6 +1467,29 @@ def _build_workloads():
         sv._scatter_admission(st4, new,
                               jnp.arange(128, dtype=jnp.int32),
                               dev_i32(0))
+        # Hot-key result-cache overlay (ISSUE 12): probe-fused admit
+        # (state + cache donated), harvest fill, standalone degrade
+        # probe, epoch-bump invalidate, and the sharded cached scatter
+        # — every donated operand freshly built, never reused.
+        eng_c = sv.ServeEngine(swarm, cfg, slots=256, admit_cap=128,
+                               cache_slots=256)
+        stc = eng_c.empty()
+        stc, _h, _f, _hp = eng_c.admit_probed(
+            stc, targets[:128], jnp.arange(128, dtype=jnp.int32),
+            key, 0)
+        eng_c.fill_cache(np.asarray(targets[:8]),
+                         np.full((8, cfg.quorum), -1, np.int32),
+                         np.zeros((8,), np.int32), 1)
+        eng_c.probe_cache(targets[:128])
+        eng_c.invalidate_cache()
+        st5 = sv.empty_serve_state(cfg, 256)
+        cache5 = sv.empty_result_cache(cfg, 256)
+        new5 = sw.lookup_init(swarm, cfg, targets[:128],
+                              sw._sample_origins(jax.random.PRNGKey(23),
+                                                 swarm.alive, 128))
+        sv._scatter_admission_cached(st5, cache5, new5,
+                                     jnp.arange(128, dtype=jnp.int32),
+                                     dev_i32(0))
 
     def storage_paths():
         scfg = stg.StoreConfig(slots=4, listen_slots=2,

@@ -1,8 +1,26 @@
-"""Sliding-window rate limiting (ref: include/opendht/rate_limiter.h:26-48).
+"""Inbound rate limiting (ref: include/opendht/rate_limiter.h:26-48).
 
-Quota per 1-second sliding window, implemented as a deque of timestamps.
-Used by the network engine both globally (1600 req/s) and per source IP
-(200 req/s, IPv6 grouped by /64 — ref: network_engine.h:462,572-599).
+Two interchangeable limiters behind one ``limit(now) -> bool`` API:
+
+* :class:`RateLimiter` — the reference's sliding window: quota per
+  trailing 1-second window, implemented as a deque of timestamps.
+  Exact, but ``limit`` is O(window) deque churn per packet and the
+  deque holds up to ``quota`` floats PER SOURCE — the per-IP map pays
+  that for every distinct sender.
+* :class:`TokenBucket` — the classic token bucket: ``quota`` tokens/s
+  accrue up to a ``burst`` ceiling (default ``quota``), one token per
+  admitted hit.  O(1) time and O(1) state per source.  At any steady
+  arrival rate its long-run admit rate equals the sliding window's
+  (``min(arrival, quota)`` per second — property-tested in
+  tests/test_rate_limiter.py); the transient difference is burst
+  shape only: the window forgets a burst exactly 1 s later, the
+  bucket refills it continuously.
+
+Used by the network engine both globally (1600 req/s) and per source
+IP (200 req/s, IPv6 grouped by /64 — ref: network_engine.h:462,
+572-599).  The per-IP map uses the token bucket (O(1) state per
+sender — a flood of distinct sources must not also buy a deque each);
+the global limiter keeps the reference's exact sliding window.
 """
 
 from __future__ import annotations
@@ -32,10 +50,78 @@ class RateLimiter:
         return len(self._hist)
 
 
-def make_rate_limiter(quota: int):
-    """Prefer the native (C++) sliding-window limiter when available —
-    this sits on the per-packet inbound path (ref:
-    network_engine.h:462)."""
+class TokenBucket:
+    """O(1) token-bucket limiter: ``quota`` tokens per second accrue
+    up to ``burst`` (default ``quota``); each admitted hit spends one.
+
+    Same ``limit(now)`` / ``maintain(now)`` surface as
+    :class:`RateLimiter` so the two are drop-in interchangeable.
+    ``maintain`` returns the current spent-capacity estimate
+    (``burst - tokens``, rounded) — the bucket's analogue of the
+    window's in-flight count.  A ``now`` that goes backwards accrues
+    nothing (monotone clocks only owe monotone behavior).
+    """
+
+    __slots__ = ("quota", "burst", "_tokens", "_last")
+
+    def __init__(self, quota: float, burst: float | None = None):
+        if quota <= 0:
+            raise ValueError(f"token-bucket quota must be > 0, got "
+                             f"{quota}")
+        self.quota = float(quota)
+        self.burst = float(burst) if burst is not None else float(quota)
+        if self.burst < 1.0:
+            raise ValueError(f"token-bucket burst must be >= 1, got "
+                             f"{self.burst}")
+        self._tokens = self.burst
+        self._last: float | None = None
+
+    def _refill(self, now: float) -> None:
+        # ``_last`` only ever moves FORWARD: a backwards ``now`` must
+        # not rewind it, or the next forward sample would re-credit
+        # wall time that was already banked (observed-at-review
+        # failure mode: t=10, t=0, t=10 again would accrue 10 s of
+        # tokens although no time passed since the first sample).
+        if self._last is None:
+            self._last = now
+            return
+        dt = now - self._last
+        if dt > 0:
+            self._tokens = min(self.burst,
+                               self._tokens + dt * self.quota)
+            self._last = now
+
+    def limit(self, now: float) -> bool:
+        """Record a hit at ``now``; return True if a token was
+        available (and spend it)."""
+        self._refill(now)
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+    def maintain(self, now: float) -> int:
+        self._refill(now)
+        return int(round(self.burst - self._tokens))
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+
+def make_rate_limiter(quota: int, kind: str = "sliding"):
+    """Build a limiter for the inbound path.
+
+    ``kind="sliding"`` prefers the native (C++) sliding-window limiter
+    when available — this sits on the per-packet inbound path (ref:
+    network_engine.h:462).  ``kind="token-bucket"`` returns the O(1)
+    :class:`TokenBucket` — what the per-IP limiter map uses, so state
+    per distinct sender is one float pair instead of a deque.
+    """
+    if kind == "token-bucket":
+        return TokenBucket(quota)
+    if kind != "sliding":
+        raise ValueError(f"unknown rate-limiter kind {kind!r}")
     try:
         from ..native import NativeRateLimiter, available
         if available():

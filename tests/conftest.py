@@ -29,3 +29,22 @@ def pytest_configure(config):
         "markers",
         "slow: long-running scenario excluded from the tier-1 subset "
         "(-m 'not slow')")
+
+
+def virtual_clock(step: float = 0.002):
+    """Deterministic injectable (clock, sleep) pair: every clock()
+    READ advances time by ``step`` (tick-on-read is what makes loop
+    runs a pure function of the schedule), sleep() advances by its
+    argument.  Shared by the serve/soak bit-identity proofs — the two
+    suites must agree on the clock contract, or an extra clock() call
+    in one loop silently passes in the other."""
+    t = [0.0]
+
+    def clock():
+        t[0] += step
+        return t[0]
+
+    def sleep(s):
+        t[0] += s
+
+    return clock, sleep

@@ -1,0 +1,147 @@
+"""Token-bucket rate limiter (ISSUE 12 satellite).
+
+The sliding-window limiter is exact but O(window) deque churn per
+packet and O(quota) floats per source; the token bucket is O(1) both
+ways.  The contract that makes them interchangeable on the per-IP
+path: at any STEADY arrival rate the long-run admit rate is identical
+(``min(arrival, quota)``/s) — property-tested across rates below the
+quota, at it, and far above it.  Burst shape is the one allowed
+difference (window forgets after exactly 1 s, bucket refills
+continuously), pinned by its own tests.
+"""
+
+import itertools
+
+import pytest
+
+from opendht_tpu.utils.rate_limiter import (
+    RateLimiter,
+    TokenBucket,
+    make_rate_limiter,
+)
+
+
+class TestTokenBucket:
+    def test_burst_then_dry(self):
+        tb = TokenBucket(10)
+        assert sum(tb.limit(0.0) for _ in range(15)) == 10
+        assert not tb.limit(0.0)
+
+    def test_refills_at_rate(self):
+        tb = TokenBucket(10)
+        for _ in range(10):
+            tb.limit(0.0)
+        assert not tb.limit(0.0)
+        # 0.5 s at 10 tokens/s -> 5 tokens back.
+        assert sum(tb.limit(0.5) for _ in range(10)) == 5
+
+    def test_burst_ceiling_caps_accrual(self):
+        tb = TokenBucket(10, burst=3)
+        # A long idle gap cannot bank more than the ceiling.
+        assert sum(tb.limit(100.0) for _ in range(10)) == 3
+
+    def test_backwards_clock_accrues_nothing(self):
+        tb = TokenBucket(10, burst=2)
+        tb.limit(5.0)
+        tb.limit(5.0)
+        assert not tb.limit(4.0)     # now went backwards: no refill
+
+    def test_maintain_reports_spent_capacity(self):
+        tb = TokenBucket(10)
+        assert tb.maintain(0.0) == 0
+        tb.limit(0.0)
+        tb.limit(0.0)
+        assert tb.maintain(0.0) == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TokenBucket(0)
+        with pytest.raises(ValueError):
+            TokenBucket(10, burst=0.5)
+
+    @pytest.mark.parametrize("arrival_rate", [50, 200, 400, 1000])
+    def test_steady_rate_equivalence_to_sliding_window(
+            self, arrival_rate):
+        """The satellite's property: at a steady arrival rate the two
+        limiters admit the same long-run rate (min(arrival, quota))
+        — measured over the final 7 s of a 10 s run so both have
+        passed their transient (the window's first-second free burst;
+        the bucket's banked initial ceiling, which over-quota streams
+        drain at ``arrival - quota`` tokens/s, gone by t=2 at the
+        rates tested)."""
+        quota = 200
+        sw, tb = RateLimiter(quota), TokenBucket(quota)
+        dt = 1.0 / arrival_rate
+        sw_admit = tb_admit = 0
+        for i in itertools.count():
+            now = i * dt
+            if now >= 10.0:
+                break
+            a, b = sw.limit(now), tb.limit(now)
+            if now >= 3.0:
+                sw_admit += a
+                tb_admit += b
+        expect = min(arrival_rate, quota) * 7.0
+        assert abs(sw_admit - expect) <= 0.02 * expect + 2
+        assert abs(tb_admit - expect) <= 0.02 * expect + 2
+        assert abs(sw_admit - tb_admit) <= 0.02 * expect + 2
+
+    def test_same_instant_flood_parity(self):
+        """The network-engine flood test's shape: N hits at one
+        timestamp admit exactly ``quota`` under BOTH limiters."""
+        quota = 200
+        sw, tb = RateLimiter(quota), TokenBucket(quota)
+        assert sum(sw.limit(0.0) for _ in range(300)) == quota
+        assert sum(tb.limit(0.0) for _ in range(300)) == quota
+
+
+class TestMakeRateLimiter:
+    def test_kinds(self):
+        tb = make_rate_limiter(100, kind="token-bucket")
+        assert isinstance(tb, TokenBucket)
+        sl = make_rate_limiter(100)
+        assert hasattr(sl, "limit")
+        with pytest.raises(ValueError):
+            make_rate_limiter(100, kind="leaky")
+
+    def test_network_engine_per_ip_is_token_bucket(self):
+        """The per-IP map must hold O(1)-state limiters: a flood of
+        distinct senders buys floats, not deques."""
+        from opendht_tpu.core.node_cache import NodeCache
+        from opendht_tpu.utils.infohash import InfoHash
+        from opendht_tpu.utils.sockaddr import SockAddr
+        from opendht_tpu.net.network_engine import NetworkEngine
+
+        class _Clk:
+            def now(self):
+                return 0.0
+
+        class _Sch:
+            def __init__(self):
+                self.clock = _Clk()
+
+            def add(self, *a, **k):
+                return None
+
+            def cancel(self, *a, **k):
+                return None
+
+        e = NetworkEngine(InfoHash.get("x"), 0, None, None, _Sch(),
+                          None, NodeCache())
+        assert e._rate_limit_ok(SockAddr("10.1.2.3", 4000), 0.0)
+        lim = e.ip_limiters[SockAddr("10.1.2.3", 4000).host]
+        assert isinstance(lim, TokenBucket)
+
+
+class TestBackwardsClock:
+    def test_no_recredit_after_rewind(self):
+        """A non-monotone clock must not double-credit: t=10, t=0,
+        t=10 again accrues NOTHING for the repeated t=10 sample (the
+        rewind must not reset the accrual anchor)."""
+        tb = TokenBucket(10, burst=5)
+        for _ in range(5):
+            assert tb.limit(10.0)
+        assert not tb.limit(10.0)       # dry at t=10
+        assert not tb.limit(0.0)        # rewind: accrues nothing
+        assert not tb.limit(10.0)       # back to t=10: STILL nothing
+        assert tb.limit(10.5)           # real wall time accrues again

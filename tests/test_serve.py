@@ -439,3 +439,562 @@ class TestServeBenchGate:
             "lifecycle": {}, "latency_histogram": {},
             "latency_quantiles_s": {}}))
         assert main([str(art), str(base)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: device hot-key result cache, sharded serve, admission control
+# ---------------------------------------------------------------------------
+
+from opendht_tpu.models.serve import (  # noqa: E402
+    AdmissionControl,
+    ServeEngine as _SE,
+    _cache_fill,
+    _cache_invalidate,
+    _cache_probe,
+    autotune_serve_slots,
+    empty_result_cache,
+)
+
+
+from conftest import virtual_clock  # noqa: E402 (shared clock contract)
+
+
+class TestResultCache:
+    def test_fill_then_hit_then_invalidate(self, swarm):
+        cache = empty_result_cache(CFG, 64)
+        keys = jax.random.bits(jax.random.PRNGKey(5), (8, 5),
+                               jnp.uint32)
+        found = jnp.arange(8 * CFG.quorum,
+                           dtype=jnp.int32).reshape(8, CFG.quorum)
+        hops = jnp.arange(8, dtype=jnp.int32)
+        # Cold cache: nothing hits.
+        hit, _, _ = jax.device_get(_cache_probe(cache, keys))
+        assert not hit.any()
+        cache = _cache_fill(cache, keys, found, hops,
+                            jnp.ones((8,), bool), jnp.int32(3))
+        hit, f, h = jax.device_get(_cache_probe(cache, keys))
+        assert hit.all()
+        assert np.array_equal(f, np.asarray(found))
+        assert np.array_equal(h, np.asarray(hops))
+        # Filled rows are stamped with the fill round, nothing else is.
+        from opendht_tpu.models.serve import _cache_slot_np
+        sl = _cache_slot_np(np.asarray(keys), 64)
+        fr = np.asarray(cache.fill_round)
+        assert (fr[sl] == 3).all()
+        others = np.setdiff1d(np.arange(64), sl)
+        assert (fr[others] == 0).all()
+        # Epoch bump: every entry stale in O(1).
+        cache = _cache_invalidate(cache)
+        hit, _, _ = jax.device_get(_cache_probe(cache, keys))
+        assert not hit.any()
+        # Re-fill under the NEW epoch hits again.
+        cache = _cache_fill(cache, keys, found, hops,
+                            jnp.ones((8,), bool), jnp.int32(9))
+        hit, _, _ = jax.device_get(_cache_probe(cache, keys))
+        assert hit.all()
+
+    def test_masked_fill_rows_do_not_land(self):
+        cache = empty_result_cache(CFG, 64)
+        keys = jax.random.bits(jax.random.PRNGKey(6), (4, 5),
+                               jnp.uint32)
+        found = jnp.zeros((4, CFG.quorum), jnp.int32)
+        mask = jnp.asarray([True, False, True, False])
+        cache = _cache_fill(cache, keys, found,
+                            jnp.zeros((4,), jnp.int32), mask,
+                            jnp.int32(0))
+        hit, _, _ = jax.device_get(_cache_probe(cache, keys))
+        assert hit[0] and hit[2]
+        assert not hit[1] and not hit[3]
+
+    def test_colliding_fill_evicts(self):
+        # A 1-slot cache: the second fill must evict the first.
+        cache = empty_result_cache(CFG, 1)
+        k = jax.random.bits(jax.random.PRNGKey(7), (2, 5), jnp.uint32)
+        f = jnp.zeros((2, CFG.quorum), jnp.int32)
+        z = jnp.zeros((2,), jnp.int32)
+        cache = _cache_fill(cache, k[:1], f[:1], z[:1],
+                            jnp.ones((1,), bool), jnp.int32(0))
+        cache = _cache_fill(cache, k[1:], f[1:], z[1:],
+                            jnp.ones((1,), bool), jnp.int32(0))
+        hit, _, _ = jax.device_get(_cache_probe(cache, k))
+        assert not hit[0] and hit[1]
+
+    def test_engine_validates_cache_slots(self, swarm):
+        with pytest.raises(ValueError, match="cache_slots"):
+            _SE(swarm, CFG, slots=64, cache_slots=-1)
+
+
+class TestCachePureOverlay:
+    def test_cold_cache_bit_identical_to_cache_off(self, swarm):
+        """The pure-overlay proof: the cache-ON programs with fills
+        disabled (every probe misses) produce a report bit-identical
+        to the cache-off engine on a shared virtual clock — the probe
+        changes NOTHING on the miss path."""
+        ts, keys, klass = poisson_zipf_events(
+            rate=300, duration=1.5, key_pool=256, zipf_s=1.1, seed=7)
+        c1, s1 = virtual_clock()
+        e_off = ServeEngine(swarm, CFG, slots=128, admit_cap=32)
+        r_off = serve_open_loop(e_off, ts, keys, jax.random.PRNGKey(3),
+                                klass=klass, burst=2, duration=1.5,
+                                clock=c1, sleep=s1)
+        c2, s2 = virtual_clock()
+        e_on = ServeEngine(swarm, CFG, slots=128, admit_cap=32,
+                           cache_slots=256)
+        e_on.cache_fill_enabled = False
+        r_on = serve_open_loop(e_on, ts, keys, jax.random.PRNGKey(3),
+                               klass=klass, burst=2, duration=1.5,
+                               clock=c2, sleep=s2)
+        for k in ("admitted", "completed", "expired", "in_flight",
+                  "never_admitted", "shed", "rounds", "elapsed_s",
+                  "queue_depth_mean", "queue_depth_max",
+                  "slot_occupancy_frac"):
+            assert r_off[k] == r_on[k], k
+        for k in ("request", "latency_s", "hops", "service_rounds",
+                  "found_nonempty", "klass"):
+            assert np.array_equal(np.asarray(r_off[k]),
+                                  np.asarray(r_on[k])), k
+        assert r_off["burst_marks"] == r_on["burst_marks"]
+        assert r_on["cache_hits"] == 0
+        assert r_on["cache_misses"] == r_on["admitted"]
+        assert r_off["completed"] > 0
+
+    def test_cache_hits_conserve_and_repeat_prior_answers(self, swarm):
+        """Cache-on run: hits + misses == admitted, hits complete in
+        zero service rounds with zero hops, and a hit's found head is
+        BIT-EQUAL to some earlier completion of the same key (a cache
+        can only replay what a real lookup produced)."""
+        ts, keys, klass = poisson_zipf_events(
+            rate=1200, duration=1.0, key_pool=32, zipf_s=1.3, seed=9)
+        eng = ServeEngine(swarm, CFG, slots=128, admit_cap=64,
+                          cache_slots=128)
+        rep = serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                              klass=klass)
+        assert rep["cache_hits"] > 0
+        assert rep["cache_hits"] + rep["cache_misses"] \
+            == rep["admitted"]
+        assert rep["admitted"] == rep["completed"] + rep["in_flight"] \
+            + rep["expired"]
+        sr = rep["service_rounds"]
+        hops = rep["hops"]
+        hit_mask = sr == 0
+        assert int(hit_mask.sum()) == rep["cache_hits"]
+        assert (hops[hit_mask] == 0).all()
+        # Every hit's key saw an earlier miss-path completion.
+        keys_np = np.asarray(keys)
+        req = rep["request"]
+        first_completion: dict = {}
+        for i, ri in enumerate(req):
+            kb = keys_np[ri].tobytes()
+            if sr[i] == 0:
+                assert kb in first_completion, \
+                    "hit with no prior completion of that key"
+            else:
+                first_completion.setdefault(kb, i)
+
+    def test_invalidate_cache_forces_misses(self, swarm):
+        eng = ServeEngine(swarm, CFG, slots=64, admit_cap=64,
+                          cache_slots=64)
+        k = jax.random.bits(jax.random.PRNGKey(8), (4, 5), jnp.uint32)
+        eng.fill_cache(np.asarray(k),
+                       np.zeros((4, CFG.quorum), np.int32),
+                       np.zeros((4,), np.int32), 0)
+        hit, _, _ = eng.probe_cache(k)
+        assert hit.all()
+        eng.invalidate_cache()       # the announce-path epoch bump
+        hit, _, _ = eng.probe_cache(k)
+        assert not hit.any()
+
+
+class TestAdmissionControl:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="policy"):
+            AdmissionControl(rate=100, policy="drop")
+        with pytest.raises(ValueError, match="rate"):
+            AdmissionControl(rate=0)
+
+    def test_shed_policy_survives_overload(self, swarm):
+        """The overload scenario of the acceptance criteria: a
+        firehose that USED to exit 2 now sheds gracefully — the
+        engine stays up, sheds are conserved in the accounting, and
+        every admitted request completes."""
+        ts = np.linspace(0.0, 0.01, 2000)
+        keys = np.asarray(jax.random.bits(jax.random.PRNGKey(1),
+                                          (2000, 5), jnp.uint32))
+        eng = ServeEngine(swarm, CFG, slots=64, admit_cap=64,
+                          cache_slots=128)
+        rep = serve_open_loop(
+            eng, ts, keys, jax.random.PRNGKey(3),
+            admission=AdmissionControl(rate=400, policy="shed"),
+            overload_queue_factor=4)
+        assert rep["shed"] > 0
+        assert rep["admitted"] == rep["completed"] + rep["in_flight"] \
+            + rep["expired"]
+        assert rep["admitted"] + rep["shed"] + rep["never_admitted"] \
+            == 2000
+        assert rep["completed"] > 0
+
+    def test_queue_policy_holds_head_of_line(self, swarm):
+        """Queue policy: nothing sheds; over-quota requests wait for
+        tokens (and the schedule is small enough to drain)."""
+        ts = np.zeros(30)
+        keys = np.asarray(jax.random.bits(jax.random.PRNGKey(2),
+                                          (30, 5), jnp.uint32))
+        eng = ServeEngine(swarm, CFG, slots=64, admit_cap=64)
+        rep = serve_open_loop(
+            eng, ts, keys, jax.random.PRNGKey(3),
+            admission=AdmissionControl(rate=20, burst=10,
+                                       policy="queue"),
+            overload_queue_factor=64)
+        assert rep["shed"] == 0
+        assert rep["admitted"] == 30
+        assert rep["completed"] == 30
+
+    def test_degrade_answers_hot_from_cache_only(self, swarm):
+        """Degrade policy: over-quota requests cost one cache probe —
+        a hot key that completed before answers from cache, anything
+        else sheds.  No over-quota request ever takes a slot."""
+        rng = np.random.default_rng(5)
+        pool = np.asarray(jax.random.bits(jax.random.PRNGKey(4),
+                                          (8, 5), jnp.uint32))
+        draw = rng.integers(0, 8, size=600)
+        ts = np.concatenate([np.linspace(0, 0.4, 300),
+                             np.full(300, 0.41)])
+        keys = pool[draw]
+        eng = ServeEngine(swarm, CFG, slots=64, admit_cap=64,
+                          cache_slots=64)
+        rep = serve_open_loop(
+            eng, ts, keys, jax.random.PRNGKey(3),
+            admission=AdmissionControl(rate=300, burst=50,
+                                       policy="degrade"),
+            overload_queue_factor=64)
+        assert rep["degraded_hits"] > 0
+        assert rep["cache_hits"] >= rep["degraded_hits"]
+        assert rep["admitted"] == rep["completed"] + rep["in_flight"] \
+            + rep["expired"]
+        assert rep["cache_hits"] + rep["cache_misses"] \
+            == rep["admitted"]
+
+    def test_degrade_without_cache_rejected(self, swarm):
+        eng = ServeEngine(swarm, CFG, slots=64)
+        with pytest.raises(ValueError, match="cache"):
+            serve_open_loop(eng, np.zeros(4),
+                            np.zeros((4, 5), np.uint32),
+                            jax.random.PRNGKey(3),
+                            admission=AdmissionControl(
+                                rate=10, policy="degrade"))
+
+
+class TestAutotune:
+    def test_pow2_clamped_and_monotone(self):
+        s1 = autotune_serve_slots(CFG, 1000, 0.01)
+        s2 = autotune_serve_slots(CFG, 4000, 0.01)
+        assert s1 & (s1 - 1) == 0 and s2 & (s2 - 1) == 0
+        assert s2 >= s1
+        assert autotune_serve_slots(CFG, 0.001, 0.0001) == 128
+        assert autotune_serve_slots(CFG, 1e9, 1.0, ceil=4096) == 4096
+
+    def test_little_law_shape(self):
+        # rate x service / occupancy, rounded up to a power of two:
+        # 1000 req/s x (burst_schedule+1) x 10 ms / 0.5 target.
+        from opendht_tpu.models.swarm import burst_schedule
+        want = 1000 * (burst_schedule(CFG) + 1) * 0.01 / 0.5
+        got = autotune_serve_slots(CFG, 1000, 0.01)
+        assert got >= want and got < 2 * max(want, 128)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            autotune_serve_slots(CFG, 0, 0.01)
+        with pytest.raises(ValueError):
+            autotune_serve_slots(CFG, 100, 0.01, target_occupancy=0.0)
+
+
+class TestShardedServeFirstClass:
+    """ISSUE 12 tentpole (b): the mesh serve engine as a first-class
+    citizen — closed-loop replay bit-identical to ``sharded_lookup``,
+    admission-scatter divisibility edge cases, overload behavior on
+    the mesh, and the replicated cache."""
+
+    @pytest.fixture(scope="class")
+    def mesh8(self):
+        from opendht_tpu.parallel import make_mesh
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        return make_mesh(8)
+
+    @pytest.fixture(scope="class")
+    def setup(self, mesh8):
+        cfg = SwarmConfig.for_nodes(8192)
+        sw = build_swarm(jax.random.PRNGKey(0), cfg)
+        sw = churn(sw, jax.random.PRNGKey(9), 0.3, cfg)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (1024, 5),
+                             jnp.uint32)
+        return cfg, sw, tg
+
+    def test_closed_loop_replay_bit_identical_to_sharded_lookup(
+            self, mesh8, setup):
+        """The slot-recycling admission equivalence, on the mesh: a
+        closed-loop replay through the routed admit/step path must be
+        bit-identical to ``sharded_lookup(compact=False)`` for the
+        same key — same routed init (per-shard key folding), same
+        donated routed step, same capacity provisioning."""
+        from opendht_tpu.parallel.sharded import sharded_lookup
+        cfg, sw, tg = setup
+        r_batch = sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2),
+                                 mesh8, 2.0, compact=False)
+        eng = ShardedServeEngine(sw, cfg, slots=tg.shape[0],
+                                 mesh=mesh8, capacity_factor=2.0,
+                                 admit_cap=tg.shape[0])
+        r_serve, st = closed_loop_replay(sw, cfg, tg,
+                                         jax.random.PRNGKey(2),
+                                         engine=eng)
+        assert _res_equal(r_serve, r_batch)
+        adm = np.asarray(st.admitted_round)
+        done = np.asarray(st.done)
+        com = np.asarray(st.completed_round)
+        assert (adm == 0).all()
+        assert (com[done] >= 0).all()
+
+    def test_admit_cap_divisibility_rejected(self, mesh8, setup):
+        cfg, sw, _ = setup
+        # slots divide the mesh but the admission micro-batch doesn't.
+        with pytest.raises(ValueError, match="divide"):
+            ShardedServeEngine(sw, cfg, slots=256, mesh=mesh8,
+                               admit_cap=100)
+
+    def test_slots_divisibility_rejected(self, mesh8, setup):
+        cfg, sw, _ = setup
+        with pytest.raises(ValueError, match="divide"):
+            ShardedServeEngine(sw, cfg, slots=250, mesh=mesh8)
+
+    def test_sharded_cache_hits_on_mesh(self, mesh8, setup):
+        """The replicated cache on the routed engine: hits occur, and
+        the lifecycle + cache conservation identities hold exactly."""
+        cfg, sw, _ = setup
+        ts, keys, klass = poisson_zipf_events(
+            rate=500, duration=0.5, key_pool=32, zipf_s=1.3, seed=5)
+        eng = ShardedServeEngine(sw, cfg, slots=256, mesh=mesh8,
+                                 capacity_factor=2.0, admit_cap=64,
+                                 cache_slots=128)
+        rep = serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                              klass=klass)
+        assert rep["cache_hits"] > 0
+        assert rep["cache_hits"] + rep["cache_misses"] \
+            == rep["admitted"]
+        assert rep["admitted"] == rep["completed"] + rep["in_flight"] \
+            + rep["expired"]
+        sr = rep["service_rounds"]
+        assert int((sr == 0).sum()) == rep["cache_hits"]
+
+    def test_sharded_overload_sheds_with_policy(self, mesh8, setup):
+        """Overload behavior on the mesh: a firehose against a tiny
+        sharded slot plane sheds under policy `shed` instead of
+        raising — the mesh engine inherits graceful degradation."""
+        cfg, sw, _ = setup
+        ts = np.linspace(0.0, 0.01, 1000)
+        keys = np.asarray(jax.random.bits(jax.random.PRNGKey(1),
+                                          (1000, 5), jnp.uint32))
+        eng = ShardedServeEngine(sw, cfg, slots=64, mesh=mesh8,
+                                 capacity_factor=2.0, admit_cap=64)
+        rep = serve_open_loop(
+            eng, ts, keys, jax.random.PRNGKey(3),
+            admission=AdmissionControl(rate=300, policy="shed"),
+            overload_queue_factor=4)
+        assert rep["shed"] > 0
+        assert rep["admitted"] + rep["shed"] + rep["never_admitted"] \
+            == 1000
+        assert rep["admitted"] == rep["completed"] + rep["in_flight"] \
+            + rep["expired"]
+
+    def test_sharded_overload_without_policy_still_raises(self, mesh8,
+                                                          setup):
+        cfg, sw, _ = setup
+        ts = np.linspace(0.0, 0.01, 1000)
+        keys = np.asarray(jax.random.bits(jax.random.PRNGKey(1),
+                                          (1000, 5), jnp.uint32))
+        eng = ShardedServeEngine(sw, cfg, slots=64, mesh=mesh8,
+                                 capacity_factor=2.0, admit_cap=64)
+        with pytest.raises(ServeOverloadError, match="arrival rate"):
+            serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                            overload_queue_factor=4)
+
+
+class TestServeCheckerCache:
+    """check_serve_obj's ISSUE-12 additions: shed in the offered
+    denominator, cache hit/miss conservation, the first-bucket rule
+    for hit service rounds."""
+
+    def _artifact(self, hits=40, misses=60, shed=0, degraded=0):
+        bounds = [0.001, 0.01, 0.1, 1.0]
+        admitted = hits + misses
+        counts = [hits, 60, 0, 0, 0]
+        quants = {"p50": 0.0055, "p95": 0.0093, "p99": 0.00986,
+                  "p999": 0.009986}
+        return {
+            "kind": "swarm_serve_trace",
+            "bench": {
+                "metric": "swarm_serve_req_per_sec",
+                "value": admitted / 2.0,
+                "completed": admitted,
+                "elapsed_s": 2.0,
+                "done_frac": round(admitted / (admitted + shed), 6),
+                "slot_occupancy_frac": 0.5,
+                "shed": shed,
+                "cache_hits": hits,
+                "latency_p50_s": quants["p50"],
+                "latency_p99_s": quants["p99"],
+                "platform": "cpu",
+            },
+            "lifecycle": {"admitted": admitted, "completed": admitted,
+                          "in_flight": 0, "expired": 0,
+                          "never_admitted": 0, "shed": shed,
+                          "cache_hits": hits},
+            "latency_histogram": {"bounds": bounds, "counts": counts,
+                                  "sum": 0.4, "count": admitted},
+            "latency_quantiles_s": quants,
+            "cache": {"slots": 128, "hits": hits, "misses": misses,
+                      "degraded_hits": degraded,
+                      "hit_rounds_histogram": {
+                          "bounds": [0.0, 1.0],
+                          "counts": [hits, 0, 0]}},
+        }
+
+    def _fix_quantiles(self, a):
+        # Re-derive the artifact's quantiles from its own histogram so
+        # fixtures with different counts stay self-consistent.
+        from opendht_tpu.utils.metrics import Histogram
+        h = Histogram("fix", "",
+                      buckets=a["latency_histogram"]["bounds"])
+        h.observe_bulk(a["latency_histogram"]["counts"], 0.0)
+        q = {"p50": 0.50, "p95": 0.95, "p99": 0.99, "p999": 0.999}
+        a["latency_quantiles_s"] = {
+            k: round(h.quantile(v), 6) for k, v in q.items()}
+        a["bench"]["latency_p50_s"] = a["latency_quantiles_s"]["p50"]
+        a["bench"]["latency_p99_s"] = a["latency_quantiles_s"]["p99"]
+        return a
+
+    def test_valid_cache_artifact_passes(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        assert check_serve_obj(self._fix_quantiles(self._artifact())) \
+            == []
+
+    def test_shed_in_offered_denominator(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._fix_quantiles(self._artifact(shed=25))
+        assert check_serve_obj(a) == []
+        # A row hiding its sheds from done_frac is flagged.
+        a["bench"]["done_frac"] = 1.0
+        errs = check_serve_obj(a)
+        assert any("done_frac" in e for e in errs), errs
+
+    def test_hits_plus_misses_must_equal_admitted(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._fix_quantiles(self._artifact())
+        a["cache"]["misses"] += 1
+        errs = check_serve_obj(a)
+        assert any("conserve" in e for e in errs), errs
+
+    def test_lifecycle_cache_hits_must_match_block(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._fix_quantiles(self._artifact())
+        a["lifecycle"]["cache_hits"] = 1
+        errs = check_serve_obj(a)
+        assert any("cache_hits" in e for e in errs), errs
+
+    def test_hit_rounds_must_land_in_first_bucket(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._fix_quantiles(self._artifact())
+        hh = a["cache"]["hit_rounds_histogram"]
+        hh["counts"] = [a["cache"]["hits"] - 2, 2, 0]
+        errs = check_serve_obj(a)
+        assert any("first bucket" in e for e in errs), errs
+
+    def test_missing_cache_block_with_lifecycle_hits_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._fix_quantiles(self._artifact())
+        del a["cache"]
+        errs = check_serve_obj(a)
+        assert any("cache block" in e for e in errs), errs
+
+    def test_cache_hit_frac_bench_gate(self):
+        from opendht_tpu.tools.check_bench import check_bench_rows
+        base = {"metric": "swarm_serve_req_per_sec", "value": 1000.0,
+                "platform": "cpu", "done_frac": 1.0,
+                "latency_p99_s": 0.5, "cache_hit_frac": 0.8}
+        ok = dict(base, cache_hit_frac=0.75)
+        assert check_bench_rows(ok, base) == []
+        bad = dict(base, cache_hit_frac=0.5)
+        errs = check_bench_rows(bad, base)
+        assert any("cache_hit_frac" in e for e in errs), errs
+        # Cross-platform: skipped with the rest of the machine gates.
+        cross = dict(base, cache_hit_frac=0.1, platform="tpu")
+        assert check_bench_rows(cross, base) == []
+
+
+class TestCacheFillDedupe:
+    def test_host_slot_hash_matches_device(self):
+        """The host dedupe's numpy hash must be bit-identical to the
+        device slot function — a divergence would dedupe the wrong
+        rows and reopen the mixed-field scatter hazard."""
+        import jax.numpy as _jnp
+        from opendht_tpu.models.serve import (_cache_slot_np,
+                                              _cache_slot_of)
+        keys = jax.random.bits(jax.random.PRNGKey(21), (512, 5),
+                               jnp.uint32)
+        for k_slots in (1, 7, 64, 2048):
+            dev = np.asarray(jax.jit(
+                _cache_slot_of, static_argnums=1)(keys, k_slots))
+            host = _cache_slot_np(np.asarray(keys), k_slots)
+            assert np.array_equal(dev.astype(np.int64), host), k_slots
+
+    def test_colliding_rows_in_one_fill_stay_consistent(self, swarm):
+        """Two keys colliding on one slot inside a single fill batch:
+        the host dedupe keeps the LAST row whole — the surviving
+        entry's key and found-set belong to the same request (never
+        key A with key B's results)."""
+        eng = _SE(swarm, CFG, slots=64, admit_cap=64, cache_slots=1)
+        k = np.asarray(jax.random.bits(jax.random.PRNGKey(22), (2, 5),
+                                       jnp.uint32))
+        f = np.stack([np.full(CFG.quorum, 11, np.int32),
+                      np.full(CFG.quorum, 22, np.int32)])
+        eng.fill_cache(k, f, np.asarray([1, 2], np.int32), 0)
+        hit, got, hops = eng.probe_cache(jnp.asarray(k))
+        assert not hit[0] and hit[1]        # last writer won, whole
+        assert (got[1] == 22).all()
+        assert hops[1] == 2
+
+
+class TestHardWallSheds:
+    def test_hard_wall_sheds_backlog_under_shed_policy(self, swarm):
+        """A run that blows the 5x-horizon hard wall under policy
+        `shed` must shed its whole backlog and drain instead of
+        raising — no exit-2 path exists under the shedding policies.
+        Forced with a big-step virtual clock and a stub step that
+        never completes anything (in-flight rows retire via expiry)."""
+        ts = np.linspace(0.0, 0.1, 400)
+        keys = np.zeros((400, 5), np.uint32)
+        c1, s1 = virtual_clock(step=5.0)     # blows the wall fast
+        eng = ServeEngine(swarm, CFG, slots=16, admit_cap=16)
+        eng.step = lambda st, rnd: st
+        rep = serve_open_loop(
+            eng, ts, keys, jax.random.PRNGKey(3),
+            admission=AdmissionControl(rate=1000, policy="shed"),
+            overload_queue_factor=1000, clock=c1, sleep=s1)
+        assert rep["shed"] > 0
+        assert rep["never_admitted"] == 0
+        assert rep["in_flight"] == 0
+        assert rep["admitted"] == rep["completed"] + rep["expired"]
+        assert rep["admitted"] + rep["shed"] == 400
+
+    def test_negative_results_never_cached(self, swarm):
+        """A transient 'not found' must not be pinned: fills drop rows
+        whose found head is -1, so followers retry the lookup instead
+        of replaying the failure for a whole epoch."""
+        eng = _SE(swarm, CFG, slots=64, admit_cap=64, cache_slots=64)
+        k = np.asarray(jax.random.bits(jax.random.PRNGKey(23), (2, 5),
+                                       jnp.uint32))
+        f = np.stack([np.full(CFG.quorum, -1, np.int32),
+                      np.full(CFG.quorum, 7, np.int32)])
+        n = eng.fill_cache(k, f, np.zeros(2, np.int32), 0)
+        assert n == 1
+        hit, _, _ = eng.probe_cache(jnp.asarray(k))
+        assert not hit[0] and hit[1]

@@ -56,17 +56,7 @@ from opendht_tpu.utils.metrics import Histogram, MetricsRegistry
 CFG = SwarmConfig.for_nodes(2048)
 
 
-def virtual_clock(step: float = 0.002):
-    t = [0.0]
-
-    def clock():
-        t[0] += step
-        return t[0]
-
-    def sleep(s):
-        t[0] += s
-
-    return clock, sleep
+from conftest import virtual_clock  # noqa: E402 (shared clock contract)
 
 
 @pytest.fixture(scope="module")
