@@ -180,6 +180,21 @@ gate: lint test
 	python -m opendht_tpu.tools.check_trace SOAK_r11.json
 	python bench.py --mode chaos --nodes 16384 --puts 2048
 	python bench.py --mode chaos-lookup --nodes 16384 --lookups 4096 --recall-sample 256
+# The AUTH leg (round 17): the device integrity plane — content-
+# addressed values verified in-jit at store-insert AND get-merge,
+# poisoned-value injection (bit-flipped payloads, forged ids, stale
+# replays) under 10% churn.  check_trace proves the artifact's exact
+# StoreTrace conservation (requests == accepts + rejects +
+# integrity_rejects, both arms, every leg), that the defended arm
+# accepted ZERO forged rows at integrity exactly 1.0 with the
+# undefended arm visibly degraded, that the measured verify overhead
+# sits inside the stated <=10% budget, and that every signature figure
+# is null (not fabricated) when the optional cryptography dep is
+# absent; check_bench re-gates the quality fields against the recorded
+# BENCH_GATE_r13.json row.
+	python bench.py --mode auth --nodes 16384 --puts 2048 --repeat 3 --auth-out /tmp/auth.json
+	python -m opendht_tpu.tools.check_trace /tmp/auth.json
+	python -m opendht_tpu.tools.check_bench /tmp/auth.json BENCH_GATE_r13.json
 
 # Profiling workflow (README "Profiling"): the gate-config cost ledger
 # with its roofline verdict, plus the small republish-sweep profile —

@@ -64,6 +64,28 @@ def check_value_signature(v: Value) -> bool:
             and v.owner.check_signature(v.get_to_sign(), v.signature))
 
 
+def verify_values_batch(values: List[Value]) -> List[bool]:
+    """Batched signature verify — the host half of the device
+    integrity plane (:mod:`opendht_tpu.models.integrity`).
+
+    The reference verifies one value per ``getCallbackFilter``
+    callback (src/securedht.cpp:237-279); the device engines harvest
+    values in batches, so the verify is batch-shaped too: one call per
+    harvested batch, serialization amortized, and — driven from a
+    :class:`~opendht_tpu.models.integrity.SignatureStage` worker —
+    the per-value OpenSSL verifies release the GIL, overlapping the
+    next device lookup burst.  A malformed value verifies False, it
+    never aborts the batch (one poisoned harvest row must not take
+    down the stage)."""
+    out = []
+    for v in values:
+        try:
+            out.append(check_value_signature(v))
+        except Exception:
+            out.append(False)
+    return out
+
+
 def encrypt_value(v: Value, from_key: PrivateKey, to: PublicKey) -> Value:
     """Sign ``v`` with ``from_key`` and return the version encrypted for
     ``to`` (ref: Value::encrypt value.h:327-335)."""

@@ -1,0 +1,213 @@
+"""Device integrity plane: content-addressed values + pipelined
+host signature verify.
+
+The crypto overlay (ref ``src/securedht.cpp``) was the last reference
+capability with no device story: signature checking is host-only and
+optional-dep gated, so a forged or corrupted payload on the device
+engines was indistinguishable from an honest one.  This module closes
+the gap with the same defense shape PR 2 used for distance claims —
+**trusted claims verified inside the jit at the point they could do
+damage**:
+
+* **content-addressed ids** — a value's id is ``SHA-1(payload bytes)``
+  (:func:`content_ids`, the device digest; :func:`content_ids_host`
+  the bit-identical hashlib twin).  With ``StoreConfig.verify`` set,
+  the store-insert programs recompute the digest of every arriving
+  payload and REJECT rows whose claimed id contradicts it (booked in
+  ``StoreTrace.integrity_rejects`` with exact accept+reject
+  conservation), and the get probe discards forged candidate replicas
+  inside the jit before they can enter a result set — the storage twin
+  of PR 2's merge-time distance-claim verification.  What this
+  defends: payload substitution, bit corruption, forged-id injection.
+  What it cannot defend: values that are legitimately mutable under
+  one id (seq-updatable values) — those need host signatures.
+* **pipelined signature stage** — RSA verify stays host-side (the
+  reference's ``Value::checkSignature``), but becomes a BATCH stage
+  (:class:`SignatureStage`): a harvested value batch is submitted to a
+  worker thread whose OpenSSL verifies release the GIL, so the host
+  crypto overlaps the next device lookup burst instead of serializing
+  per value.  The ``cryptography`` dep stays OPTIONAL: without it the
+  stage stays constructible and the signed legs report ``null``
+  instead of crashing (``tests/test_integrity.py`` pins that path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.sha1 import sha1_words
+
+try:                                      # optional dep (PR 1 contract)
+    from ..crypto.securedht import check_value_signature  # noqa: F401
+    HAVE_CRYPTO = True
+except ImportError:
+    HAVE_CRYPTO = False
+
+
+@jax.jit
+def content_ids(payloads: jax.Array) -> jax.Array:
+    """Batched content-addressed value ids: ``id = SHA-1(payload)``.
+
+    ``payloads [..., W] uint32`` — the fixed-width value bytes exactly
+    as the store holds them (word j = bytes 4j..4j+3 big-endian).
+    Returns ``[..., 5] uint32`` digest limbs — the storage KEY a
+    content-addressed announce uses, and the claim the verified insert
+    re-derives.  The jitted entry wraps :func:`~opendht_tpu.ops.sha1.
+    sha1_words`; the insert/get programs inline the same traced body.
+    """
+    return sha1_words(payloads)
+
+
+def content_ids_host(payloads) -> np.ndarray:
+    """Bit-identical hashlib twin of :func:`content_ids` for ``[P, W]``
+    uint32 payload rows (parity pinned in tests — the host and device
+    views of one id must be interchangeable, like the PHT keys)."""
+    pl = np.ascontiguousarray(np.asarray(payloads, np.uint32))
+    if pl.ndim == 1:
+        pl = pl[None]
+    out = np.zeros((pl.shape[0], 5), np.uint32)
+    be = pl.astype(">u4")
+    for i in range(pl.shape[0]):
+        d = hashlib.sha1(be[i].tobytes()).digest()
+        out[i] = np.frombuffer(d, dtype=">u4").astype(np.uint32)
+    return out
+
+
+def forge_payloads(payloads, key: jax.Array, flip_frac: float = 1.0):
+    """Adversarial payload mutation for the auth scenario: flip ONE bit
+    in a ``flip_frac`` fraction of rows (a corrupted or maliciously
+    substituted value whose claimed id no longer matches).  Returns the
+    mutated ``[P, W]`` array and the boolean mask of mutated rows."""
+    pl = jnp.asarray(payloads, jnp.uint32)
+    p, w = pl.shape
+    k1, k2, k3 = jax.random.split(key, 3)
+    hit = jax.random.uniform(k1, (p,)) < flip_frac
+    col = jax.random.randint(k2, (p,), 0, max(w, 1))
+    bit = jax.random.randint(k3, (p,), 0, 32).astype(jnp.uint32)
+    mask = jnp.zeros((p, w), jnp.uint32).at[
+        jnp.arange(p), jnp.clip(col, 0, w - 1)].set(
+        jnp.uint32(1) << bit)
+    return jnp.where(hit[:, None], pl ^ mask, pl), hit
+
+
+# ---------------------------------------------------------------------------
+# pipelined host signature stage
+# ---------------------------------------------------------------------------
+
+class SignatureStage:
+    """Pipelined batch signature verify — the host half of the
+    integrity plane.
+
+    The reference verifies one value per callback
+    (``getCallbackFilter``, src/securedht.cpp:237-279); under an
+    open-loop device engine that per-value cadence would serialize the
+    host crypto against the device rounds.  This stage instead takes
+    whole harvested batches: :meth:`submit` enqueues a batch and
+    returns immediately, a single worker thread runs the RSA verifies
+    (OpenSSL releases the GIL, so the verify wall genuinely overlaps
+    the next device lookup burst the caller dispatches), and
+    :meth:`drain` joins and returns the stats.
+
+    Without the optional ``cryptography`` dep the stage is still
+    constructible with ``available == False``: submissions are counted
+    and ``verified``/``failed``/``verifies_per_sec`` report ``None`` —
+    the signed legs degrade to null instead of crashing (the crawl
+    mode's optional-dep contract, now tested).
+    """
+
+    def __init__(self):
+        self.available = HAVE_CRYPTO
+        self.submitted = 0
+        self.batches = 0
+        self._verified = 0
+        self._failed = 0
+        self._verify_wall = 0.0
+        self._q: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._drained = False
+        self._worker: Optional[threading.Thread] = None
+        if self.available:
+            self._worker = threading.Thread(target=self._run,
+                                            daemon=True)
+            self._worker.start()
+
+    def _run(self) -> None:
+        from ..crypto.securedht import verify_values_batch
+        while True:
+            batch = self._q.get()
+            if batch is None:
+                return
+            t0 = time.perf_counter()
+            ok = sum(verify_values_batch(batch))
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._verified += ok
+                self._failed += len(batch) - ok
+                self._verify_wall += dt
+
+    def submit(self, values: List) -> None:
+        """Enqueue one harvested value batch (returns immediately —
+        the device work the caller dispatches next overlaps the
+        worker's verify wall).  A drained stage refuses: counting a
+        batch the dead worker will never verify would break the
+        ``verified + failed == submitted`` conservation the checker
+        gates."""
+        if self._drained:
+            raise RuntimeError(
+                "SignatureStage.submit after drain: the worker has "
+                "exited — build a fresh stage per measured leg")
+        self.submitted += len(values)
+        self.batches += 1
+        if self.available and values:
+            self._q.put(list(values))
+
+    def drain(self) -> dict:
+        """Join the worker and return the stage stats.  ``null`` crypto
+        figures without the optional dep — the artifact field contract
+        the checker and the crawl mode share."""
+        self._drained = True
+        if self._worker is not None:
+            self._q.put(None)
+            self._worker.join()
+            self._worker = None
+        with self._lock:
+            if not self.available:
+                return {"available": False, "submitted": self.submitted,
+                        "batches": self.batches, "verified": None,
+                        "failed": None, "verify_wall_s": None,
+                        "verifies_per_sec": None}
+            vps = ((self._verified + self._failed) / self._verify_wall
+                   if self._verify_wall > 0 else None)
+            return {"available": True, "submitted": self.submitted,
+                    "batches": self.batches,
+                    "verified": self._verified,
+                    "failed": self._failed,
+                    "verify_wall_s": round(self._verify_wall, 6),
+                    "verifies_per_sec": (round(vps, 1)
+                                         if vps is not None else None)}
+
+
+def make_signed_values(n: int, key_length: int = 2048):
+    """Build ``n`` host values signed by a fresh identity, for the
+    signed-putget/listen legs.  Returns ``(values, identity)`` or
+    ``(None, None)`` without the optional dep."""
+    if not HAVE_CRYPTO:
+        return None, None
+    from ..core.value import Value
+    from ..crypto.identity import generate_identity
+    from ..crypto.securedht import sign_value
+    ident = generate_identity("auth-bench", key_length=key_length)
+    vals = []
+    for i in range(n):
+        v = Value(bytes([i & 0xFF]) * 64, value_id=i + 1)
+        sign_value(ident.key, v)
+        vals.append(v)
+    return vals, ident

@@ -594,8 +594,28 @@ class AdmissionControl:
     One :class:`~opendht_tpu.utils.rate_limiter.TokenBucket` per
     request class (the serve workload's ``hot``/``cold`` — the
     per-client axis this harness models), each accruing ``rate``
-    tokens/s up to ``burst``.  A request whose class bucket is dry is
-    handled per ``policy``:
+    tokens/s up to ``burst``.  ``per_key_rate`` adds a SECOND bucket
+    layer keyed by the request KEY (the true per-client fairness axis
+    the per-class buckets approximate — ROADMAP #1's named follow-up,
+    the reference's per-IP limiter next to its global one): a key's
+    bucket is checked FIRST, so one hot key's flood dies at its own
+    bucket without draining the shared class bucket — the hot key can
+    no longer starve cold keys of class tokens.  The check-then-spend
+    is ATOMIC across the pair (``TokenBucket.peek`` before any
+    ``limit``): a refusal by either bucket charges NEITHER, so a
+    repeatedly-refused request cannot drain the other bucket by
+    retrying.  The key map is BOUNDED: at most ``max_keys`` buckets
+    live at once, evicted LRU (an evicted key restarts with a full
+    burst — a brief over-admit for a key cold enough to be evicted,
+    never unbounded memory; the reference's IP limiter map has the
+    same decay shape).  Per-key buckets are REJECTED with the
+    ``queue`` policy: queue is head-of-line by contract, and a
+    key-dry head would block every request behind it — precisely the
+    starvation the key buckets exist to eliminate (use ``shed`` or
+    ``degrade``, where a refused request is consumed, not parked).
+
+    A request whose bucket (key or class) is dry is handled per
+    ``policy``:
 
     * ``shed``    — dropped and booked as ``shed`` in the lifecycle
       accounting (the reference's behavior: over-quota packets are
@@ -616,7 +636,10 @@ class AdmissionControl:
     POLICIES = ("shed", "queue", "degrade")
 
     def __init__(self, rate: float, burst: float | None = None,
-                 policy: str = "shed"):
+                 policy: str = "shed",
+                 per_key_rate: float | None = None,
+                 per_key_burst: float | None = None,
+                 max_keys: int = 4096):
         from ..utils.rate_limiter import TokenBucket
         if policy not in self.POLICIES:
             raise ValueError(f"admission policy must be one of "
@@ -632,13 +655,62 @@ class AdmissionControl:
             raise ValueError(f"admission burst must be >= 1, got "
                              f"{self.burst}")
         self.policy = policy
+        if per_key_rate is not None and per_key_rate <= 0:
+            raise ValueError(f"per-key admission rate must be > 0, "
+                             f"got {per_key_rate}")
+        if per_key_rate is not None and policy == "queue":
+            raise ValueError(
+                "per-key buckets are incompatible with the 'queue' "
+                "policy: queue is head-of-line, so a key-dry head "
+                "request would block every request behind it — the "
+                "exact starvation per-key fairness exists to remove; "
+                "use policy 'shed' or 'degrade'")
+        self.per_key_rate = (float(per_key_rate)
+                             if per_key_rate is not None else None)
+        self.per_key_burst = (float(per_key_burst)
+                              if per_key_burst is not None
+                              else (max(1.0, self.per_key_rate)
+                                    if self.per_key_rate else None))
+        if self.per_key_burst is not None and self.per_key_burst < 1.0:
+            raise ValueError(f"per-key admission burst must be >= 1, "
+                             f"got {self.per_key_burst}")
+        if max_keys < 1:
+            raise ValueError(f"max_keys must be >= 1, got {max_keys}")
+        self.max_keys = int(max_keys)
+        self.key_evictions = 0
         self._tb = TokenBucket                 # class, for lazy buckets
         self._buckets: dict = {}
+        from collections import OrderedDict
+        self._key_buckets: "OrderedDict" = OrderedDict()
 
-    def allow(self, klass, now: float) -> bool:
+    def allow(self, klass, now: float, key=None) -> bool:
+        # Key bucket first (when armed): an over-rate key is refused
+        # by ITS bucket before it can touch the shared class tokens —
+        # the fairness property tests/test_serve.py pins (hot key at
+        # 100x its quota, cold keys still fully admitted).  Both
+        # buckets are PEEKED before either is charged: a composite
+        # refusal must not spend the bucket that said yes, or a
+        # retried request drains it without ever being admitted.
+        kb = None
+        if self.per_key_rate is not None and key is not None:
+            kb = self._key_buckets.get(key)
+            if kb is None:
+                if len(self._key_buckets) >= self.max_keys:
+                    self._key_buckets.popitem(last=False)   # LRU out
+                    self.key_evictions += 1
+                kb = self._key_buckets[key] = self._tb(
+                    self.per_key_rate, self.per_key_burst)
+            else:
+                self._key_buckets.move_to_end(key)
+            if not kb.peek(now):
+                return False
         b = self._buckets.get(klass)
         if b is None:
             b = self._buckets[klass] = self._tb(self.rate, self.burst)
+        if not b.peek(now):
+            return False
+        if kb is not None:
+            kb.limit(now)
         return b.limit(now)
 
 
@@ -739,7 +811,9 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
                     overload_queue_factor: int = 8,
                     drain_round_cap: int | None = None,
                     clock=None, sleep=None,
-                    admission: AdmissionControl | None = None) -> dict:
+                    admission: AdmissionControl | None = None,
+                    sig_stage=None, signed=None,
+                    signed_value_of=None) -> dict:
     """Drive the serve engine against an open-loop arrival schedule.
 
     ``arrival_ts``/``keys``(/``klass``) come from
@@ -782,6 +856,17 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
     overload guard from exit-2 to graceful shedding, so an overload
     scenario ends with ``shed`` requests accounted instead of a dead
     bench.
+
+    ``sig_stage`` (a :class:`~opendht_tpu.models.integrity.
+    SignatureStage`) + ``signed`` (a ``[R]`` bool mask) admit a SIGNED
+    request class through the pipelined host verify: each harvest's
+    completed signed requests are submitted as ONE batch right after
+    the harvest, so the worker thread's RSA verifies overlap the next
+    device burst instead of serializing per value.
+    ``signed_value_of(ri)`` maps a request index to the host value
+    object the stage verifies (defaults to the index itself — the
+    counting-only path the optional-dep null contract uses).  Both
+    default off with zero behavioral change.
 
     Returns the serve report dict (see the module docstring for the
     latency reconstruction); per-request arrays are ordered by
@@ -829,6 +914,8 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
     shed = cache_hits = cache_misses = degraded_hits = 0
     drain_rounds = 0
     overload = overload_queue_factor * c
+    sig_submitted = 0
+    sig_pending: list[int] = []     # completed signed ris this iter
 
     t0 = clock()
     while True:
@@ -897,10 +984,13 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
             # 2k-deep queue).
             take = []
             qi = 0
+            per_key = admission.per_key_rate is not None
             while qi < len(queue) and len(take) < cap \
                     and len(degr) < a_cap:
                 ri = queue[qi]
-                if admission.allow(str(klass[ri]), now):
+                if admission.allow(str(klass[ri]), now,
+                                   key=(keys[ri].tobytes()
+                                        if per_key else None)):
                     take.append(ri)
                 elif admission.policy == "shed":
                     shed += 1
@@ -947,6 +1037,9 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
                     rec_found.append(int(h_found[j, 0]) >= 0)
                     completed += 1
                     cache_hits += 1
+                    if sig_stage is not None and signed is not None \
+                            and signed[ri]:
+                        sig_pending.append(ri)
             else:
                 st = engine.admit(st, jnp.asarray(keys_np),
                                   jnp.asarray(slots_np),
@@ -974,6 +1067,9 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
                     completed += 1
                     cache_hits += 1
                     degraded_hits += 1
+                    if sig_stage is not None and signed is not None \
+                            and signed[ri]:
+                        sig_pending.append(ri)
                 else:
                     shed += 1
 
@@ -1028,6 +1124,9 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
             rec_rounds.append(cr - int(adm_r[slot]) + 1)
             rec_found.append(int(found[slot, 0]) >= 0)
             completed += 1
+            if sig_stage is not None and signed is not None \
+                    and signed[ri]:
+                sig_pending.append(ri)
             if use_cache:
                 fill_k.append(keys[ri])
                 fill_f.append(found[slot])
@@ -1038,6 +1137,14 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
             # dispatch, no sync.
             engine.fill_cache(np.asarray(fill_k), np.asarray(fill_f),
                               np.asarray(fill_h), rnd)
+        if sig_stage is not None and sig_pending:
+            # ONE batch per harvest: the stage's worker verifies while
+            # the NEXT iteration's burst runs on device — the
+            # pipelined signature contract.
+            sig_stage.submit([signed_value_of(ri) if signed_value_of
+                              else ri for ri in sig_pending])
+            sig_submitted += len(sig_pending)
+            sig_pending = []
 
         # --- expiry: rows past their round budget (the batch engine's
         # max_steps cap) retire instead of squatting on their slot.
@@ -1060,6 +1167,13 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
                 break
 
     elapsed = clock() - t0
+    if sig_stage is not None and sig_pending:
+        # Completions from an iteration that exited before its burst
+        # (idle-gap break / drain end) still reach the stage.
+        sig_stage.submit([signed_value_of(ri) if signed_value_of
+                          else ri for ri in sig_pending])
+        sig_submitted += len(sig_pending)
+        sig_pending = []
     return {
         "slots": c,
         "admit_cap": a_cap,
@@ -1075,6 +1189,7 @@ def serve_open_loop(engine: ServeEngine, arrival_ts, keys, key,
         "degraded_hits": degraded_hits,
         "cache_slots": getattr(engine, "cache_slots", 0),
         "admission_policy": admission.policy if admission else None,
+        "sig_submitted": sig_submitted,
         "rounds": rnd,
         "elapsed_s": elapsed,
         "sustained_rps": completed / elapsed if elapsed > 0 else 0.0,

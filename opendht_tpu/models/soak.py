@@ -115,6 +115,41 @@ def _scatter_wclass(wc: jax.Array, slots: jax.Array,
     return wc.at[slots].set(jnp.asarray(cls, jnp.int32), mode="drop")
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3, 4))
+def _admit_serve_cached(swarm: Swarm, cfg: SwarmConfig, st, wc, cache,
+                        keys: jax.Array, slots: jax.Array,
+                        cls: jax.Array, probe_ok: jax.Array,
+                        origins: jax.Array, rnd: jax.Array):
+    """Serve admission with the hot-key result cache probe FUSED in —
+    the soak twin of ``serve._admit_cached`` plus the work-class tag
+    (ROADMAP #1's soak follow-up: ``cache_slots`` was provisioning-only
+    before this program existed).
+
+    ``probe_ok [A]`` masks WHICH rows may consume a cache hit: only
+    READ-class rows — a WRITE must always take a slot and run its
+    lookup, because its completion fold reads the live state at its
+    slot and its announce heads must reflect the current swarm, and a
+    maintenance row is never admitted through this program at all.
+    Hit rows redirect to the drop sentinel in BOTH scatters (state and
+    work-class plane), so a hit occupies no slot and leaves no stale
+    tag; misses scatter exactly like the plain path.  State, plane and
+    cache are all DONATED (single-owner carries); the cache passes
+    through unchanged — fills stay a harvest-side concern.
+    Returns ``(st, wc, cache, hit [A], hit_found [A,q],
+    hit_hops [A])``.
+    """
+    from .serve import _probe_impl
+    c = st.done.shape[0]
+    hit_raw, h_found, h_hops = _probe_impl(cache, keys)
+    hit = hit_raw & probe_ok
+    new = init_impl(swarm.ids, _local_respond(swarm, cfg), cfg, keys,
+                    origins)
+    eff = jnp.where(hit, jnp.int32(c), slots)
+    st = _scatter_rows_into(st, new, eff, rnd)
+    wc = wc.at[eff].set(jnp.asarray(cls, jnp.int32), mode="drop")
+    return st, wc, cache, hit, h_found, h_hops
+
+
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2, 3))
 def _admit_maintenance(swarm: Swarm, cfg: SwarmConfig, st, wc,
                        pool_keys: jax.Array, pool_idx: jax.Array,
@@ -332,15 +367,15 @@ class SoakEngine:
                  maint_key: jax.Array | None = None,
                  cache_slots: int = 0):
         self.swarm, self.cfg = swarm, cfg
-        # ``cache_slots`` PROVISIONS the serve engine's hot-key result
-        # cache (and arms the write-flush epoch invalidation below)
-        # for callers that drive admissions through
-        # ``serve.admit_probed``.  The stock :func:`soak_open_loop`
-        # still admits through the plain path and does NOT consult the
-        # cache yet — probing + hit bookkeeping inside the soak loop
-        # (hits must skip the work-class plane too) is the ROADMAP #1
-        # follow-up.  0 (default) keeps the engine byte-identical to
-        # the pre-cache one.
+        # ``cache_slots`` arms the serve engine's hot-key result cache
+        # AND the soak loop's probe-fused admission
+        # (:func:`_admit_serve_cached`): READ-class requests that hit
+        # complete at their admission wall without a slot or a
+        # work-class tag, harvested read completions fill the cache,
+        # and the write-flush store insert bumps the epoch (the
+        # announce-side invalidation).  0 (default) keeps the engine
+        # byte-identical to the pre-cache one (the pure-overlay /
+        # serve-bit-identity contract in tests/test_soak.py).
         self.serve = ServeEngine(swarm, cfg, slots,
                                  admit_cap=admit_cap,
                                  cache_slots=cache_slots)
@@ -377,13 +412,28 @@ class SoakEngine:
             _soak_snapshot(self.swarm, self.cfg, st, self.wc))
 
     def admit_serve(self, st, keys, slots, cls_np, key, rnd):
-        """Serve-side admission: the UNMODIFIED serve admit (so the
-        maintenance-off path stays bit-identical to the serve engine)
-        plus one work-class scatter on the plane."""
-        st = self.serve.admit(st, keys, slots, key, rnd)
-        self.wc = _scatter_wclass(self.wc, slots,
-                                  jnp.asarray(cls_np, jnp.int32))
-        return st
+        """Serve-side admission.  Cache off: the UNMODIFIED serve
+        admit (so the maintenance-off path stays bit-identical to the
+        serve engine) plus one work-class scatter on the plane; hit
+        info comes back ``None``.  Cache on: the probe-fused
+        :func:`_admit_serve_cached` — READ rows that hit never occupy
+        their slot or tag the plane, and ``(hit, hit_found, hit_hops)``
+        come back as host arrays (the one small per-admission sync the
+        cache-on loop pays, exactly like ``serve.admit_probed``)."""
+        if self.serve.cache is None:
+            st = self.serve.admit(st, keys, slots, key, rnd)
+            self.wc = _scatter_wclass(self.wc, slots,
+                                      jnp.asarray(cls_np, jnp.int32))
+            return st, None, None, None
+        origins = _sample_origins(key, self.swarm.alive, keys.shape[0])
+        probe_ok = jnp.asarray(np.asarray(cls_np) == WC_READ)
+        st, self.wc, self.serve.cache, hit, h_found, h_hops = \
+            _admit_serve_cached(
+                self.swarm, self.cfg, st, self.wc, self.serve.cache,
+                keys, slots, jnp.asarray(cls_np, jnp.int32), probe_ok,
+                origins, dev_i32(rnd))
+        h, f, hp = jax.device_get((hit, h_found, h_hops))
+        return st, h, f, hp
 
     def admit_maintenance(self, st, sweep: _Sweep, pool_idx_np,
                           slots_np, rnd):
@@ -450,6 +500,25 @@ class SoakEngine:
         self.wc = _scatter_wclass(
             self.wc, jnp.full((a_cap,), c, jnp.int32),
             jnp.zeros((a_cap,), jnp.int32))
+        if self.serve.cache is not None:
+            # Probe-fused soak admission: all-sentinel slots write
+            # nothing, probe_ok all-False hits nothing — the program
+            # compiles, the cache passes through untouched (the
+            # cache-cold warm contract warm_serve_engine's fill warm
+            # also keeps).
+            tmp = self.serve.empty()
+            twc = jnp.zeros((c,), jnp.int32)
+            tmp, twc, self.serve.cache, _h, _f, _hp = \
+                _admit_serve_cached(
+                    self.swarm, self.cfg, tmp, twc, self.serve.cache,
+                    jnp.zeros((a_cap, N_LIMBS), jnp.uint32),
+                    jnp.full((a_cap,), c, jnp.int32),
+                    jnp.zeros((a_cap,), jnp.int32),
+                    jnp.zeros((a_cap,), bool),
+                    _sample_origins(self.maint_key, self.swarm.alive,
+                                    a_cap),
+                    dev_i32(0))
+            jax.device_get((_h, _f, _hp))
         self.snapshot(st)
 
     def warm_repub_insert(self, st, width: int) -> None:
@@ -853,6 +922,8 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
     adm_c = [0] * N_WORK_CLASSES
     com_c = [0] * N_WORK_CLASSES
     exp_c = [0] * N_WORK_CLASSES
+    use_cache = soak.serve.cache is not None
+    cache_hits = cache_misses = 0
     drain_rounds = 0
     overload = overload_queue_factor * c
     wclass_mismatches = 0
@@ -997,9 +1068,9 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
                 admit_wall[ri] = now
                 adm_c[wcls] += 1
             keys_np[:m] = keys[np.asarray(take)]
-            st = soak.admit_serve(st, jnp.asarray(keys_np),
-                                  jnp.asarray(slots_np), cls_np,
-                                  jax.random.fold_in(key, adm_i), rnd)
+            st, hit, h_found, _h_hops = soak.admit_serve(
+                st, jnp.asarray(keys_np), jnp.asarray(slots_np),
+                cls_np, jax.random.fold_in(key, adm_i), rnd)
             adm_i += 1
             admitted += m
             if timeline is not None:
@@ -1007,6 +1078,35 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
                     {"read": int(np.sum(cls_np[:m] == WC_READ)),
                      "write": int(np.sum(cls_np[:m] == WC_WRITE))},
                     now)
+            if hit is not None:
+                # Cache-probed admission: READ rows that hit complete
+                # AT the admission wall — zero service rounds, zero
+                # slots, no work-class tag (the fused program dropped
+                # both scatters), latency = pure queueing delay.
+                for j, ri in enumerate(take):
+                    if cls_np[j] != WC_READ:
+                        continue
+                    if not hit[j]:
+                        cache_misses += 1
+                        continue
+                    slot = int(slots_np[j])
+                    occupied.pop(slot)
+                    free.append(slot)
+                    lat = max(0.0, now - float(arrival_ts[ri]))
+                    rec_req.append(ri)
+                    rec_lat.append(lat)
+                    rec_hops.append(0)
+                    rec_rounds.append(0)
+                    rec_found.append(int(h_found[j, 0]) >= 0)
+                    completed += 1
+                    com_c[WC_READ] += 1
+                    cache_hits += 1
+                    if latency_plane is not None:
+                        latency_plane.observe(
+                            lat, op=WORK_CLASS_NAMES[WC_READ])
+                    if timeline is not None:
+                        timeline.note_complete(
+                            WORK_CLASS_NAMES[WC_READ], lat, now)
 
         sched_done = next_ev >= r_total and not queue
 
@@ -1097,6 +1197,7 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
         # entry_occ == retired_this_burst + device_active_after.
         retired_b = [0] * N_WORK_CLASSES
         fold_groups: dict = {}
+        fill_k, fill_f, fill_h = [], [], []
         for slot in [s for s, _ in occupied.items() if done[s]]:
             wcls, ref = occupied.pop(slot)
             free.append(slot)
@@ -1125,6 +1226,10 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
                 rec_found.append(int(found[slot, 0]) >= 0)
                 completed += 1
                 com_c[wcls] += 1
+                if use_cache and wcls == WC_READ:
+                    fill_k.append(keys[ri])
+                    fill_f.append(found[slot])
+                    fill_h.append(int(hops[slot]))
                 if wcls == WC_WRITE:
                     fold_groups.setdefault("write", []).append(
                         (slot, ri))
@@ -1157,6 +1262,14 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
                 if timeline is not None:
                     timeline.note_complete(WORK_CLASS_NAMES[wcls],
                                            None, w)
+
+        if use_cache and fill_k:
+            # Fill the harvest's read completions so their followers
+            # hit (one donated fixed-width dispatch, no sync — the
+            # serve loop's fill contract verbatim).
+            soak.serve.fill_cache(np.asarray(fill_k),
+                                  np.asarray(fill_f),
+                                  np.asarray(fill_h), rnd)
 
         # Device-vs-host occupancy cross-check: after popping done
         # slots, the host's per-class occupancy must equal the plane's
@@ -1311,6 +1424,9 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
         "burst_marks": list(zip(marks_r, marks_w)),
         # --- soak superset ---
         "maintenance": bool(do_maint or do_mon),
+        "cache_slots": soak.serve.cache_slots,
+        "cache_hits": cache_hits,
+        "cache_misses": cache_misses,
         "lifecycle_by_class": {
             WORK_CLASS_NAMES[x]: {
                 "admitted": adm_c[x], "completed": com_c[x],

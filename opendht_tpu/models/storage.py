@@ -70,6 +70,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..ops.sha1 import sha1_words
 from ..ops.xor_metric import N_LIMBS
 from ..utils.hostdevice import dev_u32
 from .swarm import LookupResult, Swarm, SwarmConfig, lookup
@@ -200,6 +201,18 @@ class StoreConfig(NamedTuple):
     listeners WITH expiration and re-registers every ~30 s
     (/root/reference/src/dht.cpp:2299-2322); :func:`refresh_listeners`
     is that re-register sweep, :func:`expire_listeners` the reclaim.
+
+    ``verify`` arms the DEVICE INTEGRITY PLANE
+    (:mod:`opendht_tpu.models.integrity`): values are content-addressed
+    (``key = SHA-1(payload bytes)``), the insert programs recompute
+    every arriving payload's digest and reject rows whose claimed key
+    contradicts it (``StoreTrace.integrity_rejects``), and the get
+    probes discard forged candidate replicas inside the jit before
+    they can enter a result set — the storage twin of the chaos
+    engine's merge-time distance-claim verification.  Requires
+    ``payload_words > 0`` (a token-only store has no bytes to
+    address).  False (default) keeps every program byte-identical to
+    the unverified engine — the plane is a pure overlay.
     """
     slots: int = 16
     listen_slots: int = 4
@@ -208,6 +221,7 @@ class StoreConfig(NamedTuple):
     budget: int = 0
     payload_words: int = 0
     listen_ttl: int = 0
+    verify: bool = False
 
 
 class SwarmStore(NamedTuple):
@@ -264,18 +278,25 @@ class StoreTrace(NamedTuple):
     * ``rejects``        — surviving requests refused (stale seq,
       equal-seq conflict, byte budget, ring overflow/conflict);
     * ``notified``       — listener delivery matches fired
-      (``storageChanged`` → ``tellListener`` pushes).
+      (``storageChanged`` → ``tellListener`` pushes);
+    * ``integrity_rejects`` — surviving requests whose payload digest
+      contradicted their claimed content-addressed key, dropped by the
+      verified insert (``StoreConfig.verify``; always 0 with the
+      plane off).  Conservation is EXACT on dedup-free batches:
+      ``requests == accepts_update + accepts_new + rejects +
+      integrity_rejects`` — the auth gate's accounting identity.
     """
     requests: jax.Array
     accepts_update: jax.Array
     accepts_new: jax.Array
     rejects: jax.Array
     notified: jax.Array
+    integrity_rejects: jax.Array
 
     @staticmethod
     def zeros() -> "StoreTrace":
         z = jnp.int32(0)
-        return StoreTrace(z, z, z, z, z)
+        return StoreTrace(z, z, z, z, z, z)
 
     def __add__(self, other: "StoreTrace") -> "StoreTrace":
         return StoreTrace(*[a + b for a, b in zip(self, other)])
@@ -343,6 +364,11 @@ def validate_store_geometry(n_nodes: int, scfg: StoreConfig) -> None:
     default slots=4 / payload_words=64 (2.56e9 elements > 2³¹) wrapped
     exactly that way (ADVICE round 5, medium).
     """
+    if scfg.verify and not scfg.payload_words:
+        raise ValueError(
+            "StoreConfig.verify needs payload_words > 0: content-"
+            "addressed ids are digests of the value BYTES, and a "
+            "token-only store has no bytes to verify")
     lim = 2 ** 31
     rows = (n_nodes + 1) * scfg.slots
     lrows = (n_nodes + 1) * scfg.listen_slots
@@ -514,9 +540,20 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
     if w:
         same = same & jnp.all(
             s_pl == _pl_gather(flat_pl, n_safe * s + mslot, w), axis=-1)
-    upd = live & has_match & (
+    # Device integrity plane (scfg.verify, static): the claimed
+    # content-addressed key must equal the recomputed payload digest,
+    # or the row is dropped HERE — a forged id or corrupted bytes
+    # never reaches the edit policy, never takes a ring slot, and is
+    # booked as an integrity reject.  Verify-off compiles the exact
+    # pre-plane program (live_ok IS live; the trace column folds to 0).
+    if scfg.verify:
+        integ_ok = jnp.all(sha1_words(s_pl) == s_key, axis=-1)
+        live_ok = live & integ_ok
+    else:
+        live_ok = live
+    upd = live_ok & has_match & (
         (s_seq > cur_seq) | ((s_seq == cur_seq) & same))
-    new = live & ~has_match
+    new = live_ok & ~has_match
     if scfg.budget:
         # Byte budget (the reference's max_store_size rejection,
         # storageStore src/dht.cpp:2227-2258): stored bytes on the
@@ -670,8 +707,10 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
         # byte budget, or ring allocation — what the reference's
         # storageStore-returns-false / "seq must be increasing" paths
         # count one call at a time.
-        rejects=jnp.sum((live & ~upd & ~accept_new).astype(i32)),
-        notified=jnp.sum(lmatch.astype(i32)))
+        rejects=jnp.sum((live_ok & ~upd & ~accept_new).astype(i32)),
+        notified=jnp.sum(lmatch.astype(i32)),
+        integrity_rejects=(jnp.sum((live & ~integ_ok).astype(i32))
+                           if scfg.verify else jnp.int32(0)))
     return new_store, replicas, trace
 
 
@@ -772,6 +811,17 @@ def _get_probe(alive: jax.Array, cfg: SwarmConfig, store: SwarmStore,
     hit = store.used[n_safe] & ok[..., None] \
         & _key_match(store.keys, n_safe, sslots,
                      keys[:, None, :])                     # [P,Q,S]
+    if scfg.verify:
+        # Verified merge (the integrity plane's read half): every
+        # candidate replica's payload is re-digested and compared to
+        # the content-addressed key BEFORE the freshest-seq merge — a
+        # forged or corrupted replica is discarded inside the jit and
+        # can neither win the merge nor shadow an honest older copy.
+        rows3 = n_safe[..., None] * sslots \
+            + jnp.arange(sslots, dtype=jnp.int32)
+        cand_pl = _pl_gather(store.payload, rows3, scfg.payload_words)
+        hit = hit & jnp.all(sha1_words(cand_pl)
+                            == keys[:, None, None, :], axis=-1)
     sseq = jnp.where(hit, store.seqs[n_safe], 0)
     best_seq = jnp.max(sseq, axis=(1, 2))
     is_best = hit & (sseq == best_seq[:, None, None])

@@ -92,6 +92,7 @@ ENTRY_POINTS: tuple = (
     ("opendht_tpu.models.serve", "_snapshot", ()),
     ("opendht_tpu.models.serve", "_expire_slots", (0,)),
     ("opendht_tpu.models.soak", "_scatter_wclass", (0,)),
+    ("opendht_tpu.models.soak", "_admit_serve_cached", (2, 3, 4)),
     ("opendht_tpu.models.soak", "_admit_maintenance", (2, 3)),
     ("opendht_tpu.models.soak", "_fold_completed", (0,)),
     ("opendht_tpu.models.soak", "_repub_insert_completed", (4, 15)),
@@ -104,6 +105,8 @@ ENTRY_POINTS: tuple = (
     ("opendht_tpu.models.index", "_trie_node_hash", ()),
     ("opendht_tpu.models.index", "_pack_entry_payloads", ()),
     ("opendht_tpu.ops.sha1", "sha1_one_block", ()),
+    ("opendht_tpu.ops.sha1", "sha1_blocks", ()),
+    ("opendht_tpu.models.integrity", "content_ids", ()),
     ("opendht_tpu.models.monitor", "fold_sweep", (0,)),
     ("opendht_tpu.parallel.sharded", "_sharded_lookup_while", ()),
     ("opendht_tpu.parallel.sharded", "_sharded_lookup_init", ()),

@@ -1,19 +1,33 @@
-"""Batched single-block SHA-1 — device twin of ``InfoHash.get``.
+"""Batched SHA-1 — device twin of ``InfoHash.get``.
 
 The PHT secondary index locates a trie node at ``SHA-1(prefix content
-‖ size byte)`` (``Prefix.hash``, indexation/pht.py — ref pht.h:103-107).
-The device index (:mod:`opendht_tpu.models.index`) must derive the SAME
-160-bit store keys for a ``[B]`` batch of prefixes, or the host and
-device views of one index stop being interchangeable — so the hash is
-not approximated or replaced with a cheaper mix: it is SHA-1 itself,
-vectorized.
+‖ size byte)`` (``Prefix.hash``, indexation/pht.py — ref pht.h:103-107),
+and the integrity plane (:mod:`opendht_tpu.models.integrity`) addresses
+values by ``id = SHA-1(payload bytes)``.  The device engines must
+derive the SAME 160-bit digests as the host, or the host and device
+views stop being interchangeable — so the hash is not approximated or
+replaced with a cheaper mix: it is SHA-1 itself, vectorized.
 
-A trie-node message is at most ``prefix_bytes + 1 ≤ 33`` bytes, which
-always fits ONE padded 64-byte SHA-1 block (≤ 55 bytes of payload), so
-the kernel only implements the single-block compression: 80 rounds of
-uint32 rotate/xor/add over ``[B]``-shaped lanes — embarrassingly
-batch-parallel, no per-row control flow.  Equality with ``hashlib``
-(and hence ``InfoHash.get``) is pinned in ``tests/test_index.py``.
+Two entry shapes:
+
+* **single block** (:func:`sha1_one_block` over :func:`sha1_pad_le55`)
+  — the PHT trie-node message is ≤ 33 bytes and always fits one padded
+  64-byte block (≤ 55 bytes of payload);
+* **multi block** (:func:`sha1_blocks` over :func:`sha1_pad_blocks`,
+  or :func:`sha1_words` for statically fixed-width messages) — the
+  integrity plane hashes whole value payloads (``4·W`` bytes, W up to
+  the chunk width), so the compression STREAMS over padded
+  ``[B, blocks, 16]`` word rows: per static block index one 80-round
+  compression pass runs over all ``[B]`` lanes, and rows whose message
+  ended earlier carry their finished state through unchanged (a masked
+  select per block — no per-row control flow).  Bit-identity with
+  ``hashlib`` for arbitrary payload lengths, including the 55/56/64-
+  byte padding boundaries, is pinned in ``tests/test_integrity.py``.
+
+Every pass is a static Python unroll of uint32 elementwise ops (adds
+wrap mod 2³² natively in uint32): all work is ``[B]``-wide VPU-shaped
+lanes, so XLA fuses each compression into one pass per batch with no
+gather/scatter at all.
 
 The digest comes back as ``[B, 5] uint32`` big-endian words — exactly
 the packed-limb form of an :class:`~opendht_tpu.utils.infohash.InfoHash`
@@ -29,37 +43,26 @@ import jax.numpy as jnp
 _U32 = jnp.uint32
 _MASK32 = 0xFFFFFFFF
 
+# SHA-1 initialization vector (FIPS 180-4), shared by every entry.
+_IV = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
+
 
 def _rotl(x: jax.Array, n: int) -> jax.Array:
     return (x << _U32(n)) | (x >> _U32(32 - n))
 
 
-@jax.jit
-def sha1_one_block(msg: jax.Array) -> jax.Array:
-    """SHA-1 of one already-padded 64-byte block per row.
-
-    ``msg [..., 16] uint32``: the block as big-endian words — the
-    caller has already appended the 0x80 terminator and the 64-bit bit
-    length (:func:`sha1_pad_le55` builds it from raw bytes).  Returns
-    ``[..., 5] uint32`` big-endian digest words (= InfoHash limbs).
-
-    The 80-round schedule is a static Python unroll of uint32
-    elementwise ops (adds wrap mod 2³² natively in uint32): every op is
-    ``[B]``-wide, so XLA fuses the whole compression into one pass per
-    batch with no gather/scatter at all.
+def sha1_compress(state, block: jax.Array):
+    """One SHA-1 compression: fold a 64-byte ``block [..., 16]`` into
+    ``state`` (a 5-tuple of ``[...]`` uint32 lanes — kept unstacked so
+    chained compressions never round-trip through a stack/unstack
+    pair).  Returns the new 5-tuple.
     """
-    w = [msg[..., i] for i in range(16)]
+    w = [block[..., i] for i in range(16)]
     for i in range(16, 80):
         w.append(_rotl(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1))
 
-    shape = msg.shape[:-1]
-    a = jnp.full(shape, 0x67452301, _U32)
-    b = jnp.full(shape, 0xEFCDAB89, _U32)
-    c = jnp.full(shape, 0x98BADCFE, _U32)
-    d = jnp.full(shape, 0x10325476, _U32)
-    e = jnp.full(shape, 0xC3D2E1F0, _U32)
-    h0, h1, h2, h3, h4 = a, b, c, d, e
-
+    h0, h1, h2, h3, h4 = state
+    a, b, c, d, e = h0, h1, h2, h3, h4
     for i in range(80):
         if i < 20:
             f = (b & c) | (~b & d)
@@ -76,7 +79,145 @@ def sha1_one_block(msg: jax.Array) -> jax.Array:
         tmp = _rotl(a, 5) + f + e + k + w[i]
         e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
 
-    return jnp.stack([h0 + a, h1 + b, h2 + c, h3 + d, h4 + e], axis=-1)
+    return (h0 + a, h1 + b, h2 + c, h3 + d, h4 + e)
+
+
+def _iv(shape) -> tuple:
+    return tuple(jnp.full(shape, v, _U32) for v in _IV)
+
+
+@jax.jit
+def sha1_one_block(msg: jax.Array) -> jax.Array:
+    """SHA-1 of one already-padded 64-byte block per row.
+
+    ``msg [..., 16] uint32``: the block as big-endian words — the
+    caller has already appended the 0x80 terminator and the 64-bit bit
+    length (:func:`sha1_pad_le55` builds it from raw bytes).  Returns
+    ``[..., 5] uint32`` big-endian digest words (= InfoHash limbs).
+    """
+    return jnp.stack(sha1_compress(_iv(msg.shape[:-1]), msg), axis=-1)
+
+
+def n_blocks_for(n_bytes: int) -> int:
+    """Padded SHA-1 block count for an ``n_bytes`` message: the 0x80
+    terminator plus the 8-byte bit length must fit, so
+    ``⌊(n_bytes + 8) / 64⌋ + 1`` — 55 B → 1 block, 56 B → 2,
+    119 B → 2, 120 B → 3 (the boundaries the property tests pin)."""
+    return (n_bytes + 8) // 64 + 1
+
+
+@jax.jit
+def sha1_blocks(blocks: jax.Array, n_blocks: jax.Array) -> jax.Array:
+    """Streaming SHA-1 over padded multi-block rows.
+
+    ``blocks [..., NB, 16] uint32``: each row's padded message as NB
+    64-byte blocks (:func:`sha1_pad_blocks` builds them; blocks at or
+    past a row's ``n_blocks`` are ignored); ``n_blocks [...]`` is the
+    per-row ACTIVE block count (≥ 1).  The compression runs NB static
+    passes over all rows; a row whose message already ended carries its
+    finished state through a masked select — shorter rows cost the same
+    wall as the longest, which is the lock-step batch contract every
+    engine here uses.  Returns ``[..., 5] uint32`` digest words.
+    """
+    nb = blocks.shape[-2]
+    state = _iv(blocks.shape[:-2])
+    n_act = n_blocks.astype(jnp.int32)
+    for bi in range(nb):
+        new = sha1_compress(state, blocks[..., bi, :])
+        if bi == 0:
+            state = new          # every message has ≥ 1 block
+        else:
+            live = bi < n_act
+            state = tuple(jnp.where(live, n, s)
+                          for n, s in zip(new, state))
+    return jnp.stack(state, axis=-1)
+
+
+def sha1_pad_blocks(content: jax.Array, n_bytes: jax.Array):
+    """Pad per-row variable-length messages into SHA-1 blocks.
+
+    ``content [..., C] uint32`` holds the message BYTES packed
+    big-endian into words (byte ``k`` of a row is bits
+    ``[8·(3-k%4), 8·(4-k%4))`` of ``content[..., k//4]``; bytes at or
+    past that row's ``n_bytes`` must already be zero); ``n_bytes
+    [...]`` is the per-row byte length, ``n_bytes ≤ 4·C``.  Returns
+    ``(blocks [..., NB, 16], n_blocks [...])`` for
+    :func:`sha1_blocks`, with ``NB = n_blocks_for(4·C)`` static.
+
+    The 0x80 terminator lands at byte ``n_bytes`` and the 64-bit bit
+    length in the last two words of each row's LAST ACTIVE block — all
+    as masked elementwise selects over the flat word index, so rows
+    with different lengths share one compiled program.  (The length
+    words can never collide with content: ``n_bytes + 9 ≤ 64·n_blocks``
+    by construction, so the final 8 bytes of the last active block are
+    always past the message.)
+    """
+    c_words = content.shape[-1]
+    nb_static = n_blocks_for(4 * c_words)
+    nb = n_bytes.astype(jnp.int32)
+    n_blocks = (nb + 8) // 64 + 1
+    # Flat word index gw ∈ [0, 16·NB): word gw covers message bytes
+    # [4·gw, 4·gw+4).
+    words = []
+    for gw in range(16 * nb_static):
+        if gw < c_words:
+            wv = content[..., gw]
+        else:
+            wv = jnp.zeros(nb.shape, _U32)
+        in_word = (nb // 4) == gw
+        lane = jnp.clip(nb - 4 * gw, 0, 3)
+        term = jnp.where(in_word,
+                         _U32(0x80) << (_U32(8) * (3 - lane).astype(_U32)),
+                         _U32(0))
+        # 64-bit message length: high word always 0 for any 4·C < 2²⁹
+        # bytes (the int32 geometry cap), low word = 8·n_bytes at the
+        # last word of the row's last active block.
+        is_len = (16 * n_blocks - 1) == gw
+        ln = jnp.where(is_len, nb.astype(_U32) * _U32(8), _U32(0))
+        words.append(wv | term | ln)
+    blocks = jnp.stack(words, axis=-1)
+    return blocks.reshape(blocks.shape[:-1] + (nb_static, 16)), n_blocks
+
+
+def sha1_bytes(content: jax.Array, n_bytes: jax.Array) -> jax.Array:
+    """SHA-1 of per-row variable-length messages: pad
+    (:func:`sha1_pad_blocks`) + stream (:func:`sha1_blocks`).
+    ``content [..., C] uint32`` big-endian packed bytes, ``n_bytes
+    [...]`` per-row lengths ≤ 4·C.  Returns ``[..., 5]`` digests."""
+    blocks, n_blocks = sha1_pad_blocks(content, n_bytes)
+    return sha1_blocks(blocks, n_blocks)
+
+
+def sha1_words(content: jax.Array) -> jax.Array:
+    """SHA-1 of FIXED-width word rows: every row is exactly
+    ``content.shape[-1]`` uint32 words = ``4·W`` big-endian bytes (the
+    integrity plane's payload shape).  With the length static, the
+    padding folds into program constants and the per-block liveness
+    selects of :func:`sha1_blocks` vanish — this is the form the
+    verified insert/get programs inline (like ``_payload_digest``,
+    it is a plain traced function, not its own jit).
+    """
+    w = content.shape[-1]
+    n_bytes = 4 * w
+    nb = n_blocks_for(n_bytes)
+    shape = content.shape[:-1]
+    state = _iv(shape)
+    zero = jnp.zeros(shape, _U32)
+    for bi in range(nb):
+        words = []
+        for wi in range(16):
+            gw = bi * 16 + wi
+            if gw < w:
+                wv = content[..., gw]
+            elif gw == w:        # terminator at byte 4·W, lane 0
+                wv = jnp.full(shape, 0x80000000, _U32)
+            elif gw == nb * 16 - 1:
+                wv = jnp.full(shape, 8 * n_bytes, _U32)
+            else:
+                wv = zero
+            words.append(wv)
+        state = sha1_compress(state, jnp.stack(words, axis=-1))
+    return jnp.stack(state, axis=-1)
 
 
 def sha1_pad_le55(content: jax.Array, n_bytes: jax.Array) -> jax.Array:

@@ -58,6 +58,7 @@ from ..models.storage import (
     refresh_listeners,
 )
 from ..models.swarm import Swarm, SwarmConfig
+from ..ops.sha1 import sha1_words
 from ..ops.xor_metric import N_LIMBS
 from .mesh import AXIS, shard_map
 from .sharded import _bucketize, _fill_buckets, sharded_lookup
@@ -349,6 +350,16 @@ def _probe_phase_body(cfg: SwarmConfig, scfg: StoreConfig,
     valid = r_node >= 0
     hit = store_local.used[n_safe] & valid[:, None] \
         & _key_match(store_local.keys, n_safe, scfg.slots, r_key)
+    if scfg.verify:
+        # Verified merge on the owner shard (see models.storage.
+        # _get_probe): forged replicas are discarded BEFORE the
+        # freshest-seq pick, so a corrupted copy never ships back.
+        rows2 = n_safe[:, None] * scfg.slots \
+            + jnp.arange(scfg.slots, dtype=jnp.int32)
+        cand_pl = _pl_gather(store_local.payload, rows2,
+                             scfg.payload_words)
+        hit = hit & jnp.all(sha1_words(cand_pl) == r_key[:, None, :],
+                            axis=-1)
     seq = jnp.where(hit, store_local.seqs[n_safe], 0)
     best = jnp.max(seq, axis=1)
     is_b = hit & (seq == best[:, None])

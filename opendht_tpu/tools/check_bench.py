@@ -58,7 +58,7 @@ def _load_row(path: str) -> dict:
         obj = json.load(f)
     if obj.get("kind") in ("swarm_lookup_trace", "swarm_serve_trace",
                            "swarm_monitor_trace", "swarm_index_trace",
-                           "swarm_soak_trace"):
+                           "swarm_soak_trace", "swarm_auth_trace"):
         obj = obj["bench"]                           # ...artifacts
     if "value" not in obj or "metric" not in obj:
         raise ValueError(f"{path}: no BENCH row found (need "
@@ -83,6 +83,40 @@ def check_bench_rows(cur: dict, base: dict,
     if cur.get("metric") != base.get("metric"):
         errs.append(f"metric mismatch: {cur.get('metric')!r} vs "
                     f"baseline {base.get('metric')!r}")
+        return errs
+
+    if cur.get("metric") == "swarm_auth_defended_integrity":
+        # Auth rows gate as QUALITY on any platform: integrity is a
+        # correctness statement, not a machine rate.  The defended arm
+        # must be EXACTLY 1.0 (a 0.999 means a forged payload entered
+        # a result set), the defense must demonstrably have fired, and
+        # the undefended arm must stay degraded (an attack that
+        # stopped biting would let a broken verify gate green).
+        if cur["value"] != 1.0:
+            errs.append(f"defended integrity {cur['value']} != 1.0")
+        ir = cur.get("integrity_rejects")
+        if ir is not None and ir < 1:
+            errs.append("integrity_rejects 0 — the verify plane never "
+                        "fired under injection")
+        ui, ub = cur.get("undefended_integrity"), base.get(
+            "undefended_integrity")
+        if ui is not None and ub is not None and ui > ub + 0.1:
+            errs.append(f"undefended integrity {ui} well above the "
+                        f"recorded {ub} — the injection regressed")
+        # Verify overhead is a timing ratio: same-platform only, like
+        # every rate floor, and only where the wall is long enough to
+        # be signal — the SAME noise floor check_trace applies
+        # (AUTH_OVERHEAD_MIN_WALL_S), so the two checkers can never
+        # disagree on one artifact.
+        from .check_trace import AUTH_OVERHEAD_MIN_WALL_S
+        tu = cur.get("unverified_wall_s")
+        if cur.get("platform") == base.get("platform") \
+                and tu is not None and tu >= AUTH_OVERHEAD_MIN_WALL_S:
+            ov, ob = cur.get("overhead_ratio"), cur.get(
+                "overhead_budget")
+            if ov is not None and ob is not None and ov > ob:
+                errs.append(f"verify overhead_ratio {ov} above the "
+                            f"stated budget {ob}")
         return errs
 
     if cur.get("metric") in COVERAGE_METRICS:

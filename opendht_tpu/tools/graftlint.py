@@ -141,6 +141,7 @@ REGISTRY_MODULES = {
     "opendht_tpu.models.soak": "opendht_tpu/models/soak.py",
     "opendht_tpu.models.monitor": "opendht_tpu/models/monitor.py",
     "opendht_tpu.models.index": "opendht_tpu/models/index.py",
+    "opendht_tpu.models.integrity": "opendht_tpu/models/integrity.py",
     "opendht_tpu.ops.sha1": "opendht_tpu/ops/sha1.py",
     "opendht_tpu.parallel.sharded": "opendht_tpu/parallel/sharded.py",
     "opendht_tpu.parallel.sharded_storage":
@@ -1521,6 +1522,33 @@ def _build_workloads():
             jnp.zeros((m,), jnp.uint32),
             pls[:m])[0]
 
+    def integrity_plane():
+        # The device integrity plane (ISSUE 13): content-addressed
+        # announce + verified insert/get (the verify=True configs of
+        # the registered _store_insert/_announce_insert/_get_probe
+        # jits), the jitted digest entry, and the streaming multi-
+        # block SHA-1.
+        from ..models import integrity as ig
+        from ..ops.sha1 import sha1_blocks, sha1_pad_blocks
+        scfg_v = stg.StoreConfig(slots=4, listen_slots=2,
+                                 max_listeners=64, payload_words=2,
+                                 verify=True)
+        store_v = stg.empty_store(cfg.n_nodes, scfg_v)
+        pls = jax.random.bits(jax.random.PRNGKey(31), (64, 2),
+                              jnp.uint32)
+        ckeys = ig.content_ids(pls)
+        store_v, _ = stg.announce(swarm, cfg, store_v, scfg_v, ckeys,
+                                  jnp.arange(64, dtype=jnp.uint32) + 1,
+                                  jnp.ones((64,), jnp.uint32), 0,
+                                  jax.random.PRNGKey(32),
+                                  payloads=pls)
+        stg.get_values(swarm, cfg, store_v, scfg_v, ckeys,
+                       jax.random.PRNGKey(33))
+        blocks, n_blocks = sha1_pad_blocks(
+            jnp.zeros((4, 20), jnp.uint32),
+            jnp.asarray([0, 55, 56, 64], jnp.int32))
+        sha1_blocks(blocks, n_blocks)
+
     def index_kernels():
         # The device-PHT encoding jits: linearize → trie-node SHA-1 →
         # entry payload pack, plus the batched SHA-1 standalone (it is
@@ -1595,8 +1623,17 @@ def _build_workloads():
         c, a = 256, 128
         eng = sk.SoakEngine(swarm, cfg, slots=c, admit_cap=a)
         st = eng.serve.empty()
-        st = eng.admit_serve(
+        st, _h, _hf, _hh = eng.admit_serve(
             st, targets[:a], jnp.arange(a, dtype=jnp.int32),
+            np.zeros(a, np.int32), key, 0)
+        # Probe-fused soak admission (ISSUE 13): a cache-armed engine
+        # admits reads through _admit_serve_cached (state + plane +
+        # cache donated) — fresh operands, never reused.
+        eng_c = sk.SoakEngine(swarm, cfg, slots=c, admit_cap=a,
+                              cache_slots=128)
+        stc = eng_c.serve.empty()
+        stc, _h2, _hf2, _hh2 = eng_c.admit_serve(
+            stc, targets[:a], jnp.arange(a, dtype=jnp.int32),
             np.zeros(a, np.int32), key, 0)
         pool = jax.random.bits(jax.random.PRNGKey(21), (64, 5),
                                jnp.uint32)
@@ -1634,6 +1671,7 @@ def _build_workloads():
         "serve-engine": serve_engine,
         "soak-engine": soak_engine,
         "storage-paths": storage_paths,
+        "integrity-plane": integrity_plane,
         "index-kernels": index_kernels,
         "monitor-sweep": monitor_sweep,
         "sharded-engines": sharded_engines,

@@ -100,6 +100,16 @@ class TokenBucket:
             return True
         return False
 
+    def peek(self, now: float) -> bool:
+        """True if a token is available at ``now`` WITHOUT spending
+        it.  Lets a caller holding several buckets (the admission
+        control's key + class pair) check them all before committing
+        any token — a refused composite admission must not drain the
+        buckets that said yes.  ``peek`` then ``limit`` at the same
+        ``now`` is atomic: the second refill sees dt == 0."""
+        self._refill(now)
+        return self._tokens >= 1.0
+
     def maintain(self, now: float) -> int:
         self._refill(now)
         return int(round(self.burst - self._tokens))

@@ -1,0 +1,608 @@
+"""Device integrity plane (ISSUE 13): multi-block SHA-1 bit-identity,
+content-addressed verify at insert and get-merge, conservation of the
+``integrity_rejects`` column on the plain / chunked / routed insert
+paths, the pipelined signature stage's optional-dep contract, and the
+auth artifact checker.
+
+Contracts:
+
+* **hash parity** — the streaming device SHA-1 is bit-identical to
+  hashlib for arbitrary lengths including every padding boundary
+  (55/56/63/64/119/120 B), and the fixed-width digest matches both;
+* **pure overlay** — verify-off engines are bit-identical to the
+  pre-plane engine, and verify-on is bit-identical on HONEST traffic;
+* **defense** — forged ids and corrupted payloads are rejected at
+  insert (exact conservation) and discarded at get-merge before they
+  can enter a result set, locally and on the 8-device mesh;
+* **null, not crash** — without the optional ``cryptography`` dep the
+  signature stage reports null figures and the signed serve class
+  still counts its submissions.
+"""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.models.integrity import (
+    HAVE_CRYPTO,
+    SignatureStage,
+    content_ids,
+    content_ids_host,
+    forge_payloads,
+)
+from opendht_tpu.models.storage import (
+    StoreConfig,
+    StoreTrace,
+    announce,
+    empty_store,
+    get_values,
+)
+from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+from opendht_tpu.ops.sha1 import (
+    n_blocks_for,
+    sha1_blocks,
+    sha1_bytes,
+    sha1_one_block,
+    sha1_pad_blocks,
+    sha1_pad_le55,
+    sha1_words,
+)
+from opendht_tpu.tools.check_trace import check_auth_obj
+
+CFG = SwarmConfig.for_nodes(2048)
+W = 8
+
+
+@pytest.fixture(scope="module")
+def swarm():
+    return build_swarm(jax.random.PRNGKey(7), CFG)
+
+
+def _host_digest(b: bytes) -> np.ndarray:
+    return np.frombuffer(hashlib.sha1(b).digest(),
+                         dtype=">u4").astype(np.uint32)
+
+
+def _pack_words(b: bytes, c_words: int) -> np.ndarray:
+    arr = np.zeros(4 * c_words, np.uint8)
+    arr[:len(b)] = np.frombuffer(b, np.uint8)
+    return (arr.reshape(c_words, 4).astype(np.uint32)
+            @ np.array([1 << 24, 1 << 16, 1 << 8, 1], np.uint32))
+
+
+# ---------------------------------------------------------------------------
+# multi-block SHA-1 vs hashlib
+# ---------------------------------------------------------------------------
+
+class TestMultiBlockSha1:
+    # Every padding boundary the satellite names, plus the interiors
+    # of 0..3 blocks.
+    LENGTHS = (0, 1, 3, 4, 31, 54, 55, 56, 57, 63, 64, 65, 100,
+               118, 119, 120, 121, 127, 128, 180, 192)
+
+    def test_bit_identical_to_hashlib_across_lengths(self):
+        rng = np.random.default_rng(0)
+        c = 48                                # 192 B capacity, NB = 4
+        msgs = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+                for n in self.LENGTHS]
+        content = np.stack([_pack_words(m, c) for m in msgs])
+        nb = np.array([len(m) for m in msgs], np.int32)
+        dev = np.asarray(sha1_bytes(jnp.asarray(content),
+                                    jnp.asarray(nb)))
+        host = np.stack([_host_digest(m) for m in msgs])
+        assert (dev == host).all()
+
+    def test_n_blocks_boundaries(self):
+        assert n_blocks_for(55) == 1
+        assert n_blocks_for(56) == 2
+        assert n_blocks_for(63) == 2
+        assert n_blocks_for(64) == 2
+        assert n_blocks_for(119) == 2
+        assert n_blocks_for(120) == 3
+
+    def test_pad_blocks_active_counts(self):
+        blocks, nb = sha1_pad_blocks(
+            jnp.zeros((4, 30), jnp.uint32),
+            jnp.asarray([0, 55, 56, 120], jnp.int32))
+        assert blocks.shape == (4, n_blocks_for(120), 16)
+        assert np.asarray(nb).tolist() == [1, 1, 2, 3]
+
+    def test_fixed_width_matches_hashlib_and_streaming(self):
+        rng = np.random.default_rng(1)
+        for w in (1, 2, 8, 14, 16, 32):
+            msgs = [rng.integers(0, 256, 4 * w,
+                                 dtype=np.uint8).tobytes()
+                    for _ in range(5)]
+            content = np.stack([_pack_words(m, w) for m in msgs])
+            dev = np.asarray(sha1_words(jnp.asarray(content)))
+            host = np.stack([_host_digest(m) for m in msgs])
+            assert (dev == host).all(), w
+            stream = np.asarray(sha1_bytes(
+                jnp.asarray(content),
+                jnp.full((5,), 4 * w, jnp.int32)))
+            assert (dev == stream).all(), w
+
+    def test_single_block_kernel_unchanged(self):
+        # The PHT index pins sha1_one_block == hashlib; re-pin here so
+        # the compress-refactor can never drift it.
+        m = b"The quick brown fox jumps over the lazy dog"
+        blk = sha1_pad_le55(jnp.asarray(_pack_words(m, 14))[None],
+                            jnp.asarray([len(m)]))
+        assert (np.asarray(sha1_one_block(blk))[0]
+                == _host_digest(m)).all()
+
+    def test_streaming_ignores_inactive_blocks(self):
+        # Garbage past a row's active block count must not perturb its
+        # digest (the masked-select carry contract).
+        msg = b"x" * 20
+        blocks, nb = sha1_pad_blocks(
+            jnp.asarray(_pack_words(msg, 48))[None],
+            jnp.asarray([20], jnp.int32))
+        noisy = blocks.at[:, 1:].set(0xDEADBEEF)
+        dev = np.asarray(sha1_blocks(noisy, nb))[0]
+        assert (dev == _host_digest(msg)).all()
+
+
+class TestContentIds:
+    def test_device_host_parity(self):
+        pls = np.random.default_rng(2).integers(
+            0, 2 ** 32, (32, W), dtype=np.uint64).astype(np.uint32)
+        dev = np.asarray(content_ids(jnp.asarray(pls)))
+        assert (dev == content_ids_host(pls)).all()
+
+    def test_forge_moves_every_hit_digest(self):
+        pls = jax.random.bits(jax.random.PRNGKey(3), (64, W),
+                              jnp.uint32)
+        forged, hit = forge_payloads(pls, jax.random.PRNGKey(4), 0.5)
+        hit = np.asarray(hit)
+        same = np.asarray(forged) == np.asarray(pls)
+        assert same[~hit].all()
+        # A single flipped bit moves the digest on every mutated row.
+        ids0 = content_ids_host(np.asarray(pls))
+        ids1 = content_ids_host(np.asarray(forged))
+        assert (ids0[hit] != ids1[hit]).any(axis=1).all()
+        assert (ids0[~hit] == ids1[~hit]).all()
+
+
+# ---------------------------------------------------------------------------
+# verified insert + get-merge
+# ---------------------------------------------------------------------------
+
+def _conserves(tr: dict) -> bool:
+    return tr["requests"] == tr["accepts_update"] + tr["accepts_new"] \
+        + tr["rejects"] + tr["integrity_rejects"]
+
+
+def _mk(verify: bool) -> StoreConfig:
+    return StoreConfig(slots=4, listen_slots=2, max_listeners=64,
+                       payload_words=W, verify=verify)
+
+
+@pytest.fixture(scope="module")
+def honest():
+    pls = jax.random.bits(jax.random.PRNGKey(8), (64, W), jnp.uint32)
+    return pls, content_ids(pls)
+
+
+class TestVerifiedInsert:
+    def test_verify_requires_payloads(self):
+        with pytest.raises(ValueError, match="payload_words"):
+            empty_store(CFG.n_nodes, StoreConfig(verify=True))
+
+    def test_honest_traffic_pure_overlay(self, swarm, honest):
+        # Verify-on over honest content-addressed values is
+        # bit-identical to verify-off: same stores, same results,
+        # same trace modulo the (zero) integrity column.
+        pls, keys = honest
+        vals = jnp.arange(64, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((64,), jnp.uint32)
+        outs = {}
+        for verify in (False, True):
+            scfg = _mk(verify)
+            store = empty_store(CFG.n_nodes, scfg)
+            store, rep = announce(swarm, CFG, store, scfg, keys, vals,
+                                  seqs, 0, jax.random.PRNGKey(9),
+                                  payloads=pls)
+            res = get_values(swarm, CFG, store, scfg, keys,
+                             jax.random.PRNGKey(10))
+            outs[verify] = (jax.device_get(store), rep.trace.to_dict(),
+                            jax.device_get(res))
+        s0, t0, r0 = outs[False]
+        s1, t1, r1 = outs[True]
+        for a, b in zip(s0, s1):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(r0, r1):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert t0["integrity_rejects"] == t1["integrity_rejects"] == 0
+        assert {k: v for k, v in t0.items()} \
+            == {k: v for k, v in t1.items()}
+        assert _conserves(t1) and bool(np.asarray(r1.hit).all())
+
+    def test_forged_rows_rejected_with_exact_conservation(
+            self, swarm, honest):
+        pls, keys = honest
+        vals = jnp.arange(64, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((64,), jnp.uint32)
+        scfg = _mk(True)
+        store = empty_store(CFG.n_nodes, scfg)
+        store, rep = announce(swarm, CFG, store, scfg, keys, vals,
+                              seqs, 0, jax.random.PRNGKey(9),
+                              payloads=pls)
+        # Bit-flipped payloads at the honest keys, higher seq: the
+        # classic overwrite attack.
+        forged, _ = forge_payloads(pls, jax.random.PRNGKey(11), 1.0)
+        store, rep2 = announce(swarm, CFG, store, scfg, keys, vals,
+                               seqs + 1, 1, jax.random.PRNGKey(12),
+                               payloads=forged)
+        tr = rep2.trace.to_dict()
+        assert _conserves(tr)
+        assert tr["integrity_rejects"] == tr["requests"] > 0
+        assert tr["accepts_update"] == tr["accepts_new"] == 0
+        # The honest bytes survive the attack.
+        res = get_values(swarm, CFG, store, scfg, keys,
+                         jax.random.PRNGKey(13))
+        hit = np.asarray(res.hit)
+        assert hit.all()
+        assert (content_ids_host(np.asarray(res.payload))
+                == np.asarray(keys)).all()
+
+    def test_get_merge_discards_forged_replicas(self, swarm, honest):
+        # Poison the store through a verify-OFF insert, then read
+        # through the verified probe: the forged replicas must be
+        # discarded inside the jit — a corrupted payload can neither
+        # win the merge nor shadow an honest value stored elsewhere.
+        pls, keys = honest
+        vals = jnp.arange(64, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((64,), jnp.uint32)
+        scfg_off, scfg_on = _mk(False), _mk(True)
+        store = empty_store(CFG.n_nodes, scfg_off)
+        forged, _ = forge_payloads(pls, jax.random.PRNGKey(14), 1.0)
+        store, _rep = announce(swarm, CFG, store, scfg_off, keys, vals,
+                               seqs, 0, jax.random.PRNGKey(15),
+                               payloads=forged)
+        # Unverified read returns the poison; verified read refuses it.
+        res_off = get_values(swarm, CFG, store, scfg_off, keys,
+                             jax.random.PRNGKey(16))
+        assert bool(np.asarray(res_off.hit).all())
+        res_on = get_values(swarm, CFG, store, scfg_on, keys,
+                            jax.random.PRNGKey(16))
+        assert not np.asarray(res_on.hit).any()
+
+    def test_chunked_path_conserves(self, swarm):
+        # The chunked engine sums StoreTrace across its per-part
+        # inserts: the integrity column must ride the merge with the
+        # conservation identity intact.  Chunk part keys are derived
+        # (not content-addressed), so under verify every part is an
+        # integrity reject — the trace must book ALL of them.
+        from opendht_tpu.models.chunked_values import announce_chunked
+        parts = 2
+        p = 16
+        keys = jax.random.bits(jax.random.PRNGKey(17), (p, 5),
+                               jnp.uint32)
+        pls = jax.random.bits(jax.random.PRNGKey(18), (p, parts, W),
+                              jnp.uint32)
+        lens = jnp.full((p,), parts * W * 4, jnp.uint32)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((p,), jnp.uint32)
+        for verify in (False, True):
+            scfg = _mk(verify)
+            store = empty_store(CFG.n_nodes, scfg)
+            store, rep = announce_chunked(
+                swarm, CFG, store, scfg, keys, vals, seqs, 0,
+                jax.random.PRNGKey(19), pls, lens)
+            tr = rep.trace.to_dict()
+            assert _conserves(tr), tr
+            if verify:
+                assert tr["integrity_rejects"] == tr["requests"] > 0
+            else:
+                assert tr["integrity_rejects"] == 0
+
+
+@pytest.mark.usefixtures("mesh8")
+class TestShardedIntegrity:
+    @pytest.fixture(scope="class")
+    def mesh8(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        from opendht_tpu.parallel import make_mesh
+        return make_mesh(8)
+
+    def test_routed_insert_conserves_and_rejects(self, mesh8):
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_announce, sharded_empty_store, sharded_get,
+        )
+        cfg8 = SwarmConfig.for_nodes(8192)
+        sw8 = build_swarm(jax.random.PRNGKey(0), cfg8)
+        p = 256
+        pls = jax.random.bits(jax.random.PRNGKey(20), (p, W),
+                              jnp.uint32)
+        keys = content_ids(pls)
+        vals = jnp.arange(p, dtype=jnp.uint32) + 1
+        seqs = jnp.ones((p,), jnp.uint32)
+        scfg = _mk(True)
+        store = sharded_empty_store(cfg8.n_nodes, scfg, mesh8)
+        store, rep = sharded_announce(
+            sw8, cfg8, store, scfg, keys, vals, seqs, 0,
+            jax.random.PRNGKey(21), mesh8, payloads=pls)
+        tr = rep.trace.to_dict()
+        assert _conserves(tr)
+        assert tr["integrity_rejects"] == 0
+        # Forged overwrite: rejected mesh-wide, trace psum'd global.
+        forged, _ = forge_payloads(pls, jax.random.PRNGKey(22), 1.0)
+        store, rep2 = sharded_announce(
+            sw8, cfg8, store, scfg, keys, vals, seqs + 1, 1,
+            jax.random.PRNGKey(23), mesh8, payloads=forged)
+        tr2 = rep2.trace.to_dict()
+        assert _conserves(tr2)
+        assert tr2["integrity_rejects"] == tr2["requests"] > 0
+        assert tr2["accepts_update"] == tr2["accepts_new"] == 0
+        # Verified routed get: the honest bytes come back intact.
+        res = sharded_get(sw8, cfg8, store, scfg, keys,
+                          jax.random.PRNGKey(24), mesh8)
+        hit = np.asarray(res.hit)
+        assert hit.any()
+        got = np.asarray(res.payload)[hit]
+        assert (content_ids_host(got)
+                == np.asarray(keys)[hit]).all()
+
+
+# ---------------------------------------------------------------------------
+# pipelined signature stage (optional-dep contract)
+# ---------------------------------------------------------------------------
+
+class TestSignatureStage:
+    def test_null_path_without_crypto(self):
+        if HAVE_CRYPTO:
+            pytest.skip("container has cryptography; the null path "
+                        "is exercised where it is absent")
+        stage = SignatureStage()
+        assert stage.available is False
+        stage.submit(list(range(10)))
+        stage.submit(list(range(5)))
+        stats = stage.drain()
+        assert stats["available"] is False
+        assert stats["submitted"] == 15 and stats["batches"] == 2
+        for f in ("verified", "failed", "verify_wall_s",
+                  "verifies_per_sec"):
+            assert stats[f] is None, f
+
+    def test_submit_after_drain_raises(self):
+        # A drained stage's worker is gone: counting a batch it will
+        # never verify would break verified+failed == submitted
+        # (review finding) — refuse loudly instead.
+        stage = SignatureStage()
+        stage.drain()
+        with pytest.raises(RuntimeError, match="after drain"):
+            stage.submit([1])
+
+    @pytest.mark.skipif(not HAVE_CRYPTO,
+                        reason="needs the optional cryptography dep")
+    def test_verifies_conserve_with_crypto(self):
+        from opendht_tpu.models.integrity import make_signed_values
+        values, _ident = make_signed_values(8, key_length=2048)
+        bad = values[-1]
+        bad.data = b"tampered"
+        stage = SignatureStage()
+        stage.submit(values)
+        stats = stage.drain()
+        assert stats["verified"] + stats["failed"] == 8
+        assert stats["failed"] >= 1
+
+    def test_serve_signed_class_counts_submissions(self):
+        # The serve loop's signed class books exactly the completed
+        # signed requests into the stage — exercised here at the unit
+        # level through the loop's own hook (the open-loop leg rides
+        # bench --mode auth).
+        from opendht_tpu.models.serve import (
+            ServeEngine, poisson_zipf_events, serve_open_loop,
+        )
+        swarm = build_swarm(jax.random.PRNGKey(7), CFG)
+        ts, keys, klass = poisson_zipf_events(
+            rate=200, duration=1.0, key_pool=64, zipf_s=1.1, seed=7)
+        signed = np.random.default_rng(5).random(len(ts)) < 0.5
+        stage = SignatureStage()
+        eng = ServeEngine(swarm, CFG, slots=128, admit_cap=32)
+        rep = serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                              klass=klass, burst=2, duration=1.0,
+                              sig_stage=stage, signed=signed)
+        stats = stage.drain()
+        want = int(signed[rep["request"]].sum())
+        assert rep["sig_submitted"] == want
+        assert stats["submitted"] == want
+        assert rep["completed"] > 0
+
+
+# ---------------------------------------------------------------------------
+# auth artifact checker fixtures
+# ---------------------------------------------------------------------------
+
+def _trace(req, au=0, an=0, rej=0, integ=0, notified=0):
+    return {"requests": req, "accepts_update": au, "accepts_new": an,
+            "rejects": rej, "notified": notified,
+            "integrity_rejects": integ}
+
+
+def _auth_obj():
+    legs_d = {
+        "honest": _trace(512, an=512),
+        "honest_refresh": _trace(512, au=512),
+        "attack_flip": _trace(512, integ=512),
+        "attack_forge": _trace(512, integ=512),
+        "attack_replay": _trace(500, au=100, an=20, rej=380),
+    }
+    legs_u = {
+        "honest": _trace(512, an=512),
+        "honest_refresh": _trace(512, au=512),
+        "attack_flip": _trace(512, au=500, an=12),
+        "attack_forge": _trace(512, an=512),
+        "attack_replay": _trace(500, au=100, an=20, rej=380),
+    }
+    bench = {
+        "metric": "swarm_auth_defended_integrity", "value": 1.0,
+        "undefended_integrity": 0.05, "overhead_ratio": 0.031,
+        "overhead_budget": 0.10, "integrity_rejects": 1024,
+        "crypto_available": False, "platform": "cpu",
+    }
+    return {
+        "kind": "swarm_auth_trace",
+        "bench": bench,
+        "digest_parity": True,
+        "overhead": {"verified_wall_s": 1.031,
+                     "unverified_wall_s": 1.0,
+                     "ratio": 0.031, "budget": 0.10, "repeat": 2},
+        "arms": {
+            "defended": {"legs": legs_d, "integrity": 1.0,
+                         "hit_rate": 1.0},
+            "undefended": {"legs": legs_u, "integrity": 0.05,
+                           "hit_rate": 1.0},
+        },
+        "signature": {"available": False, "submitted": 256,
+                      "batches": 4, "verified": None, "failed": None,
+                      "verify_wall_s": None, "verifies_per_sec": None},
+        "serve_signed": {"signed_requests": 80, "sig_submitted": 78,
+                         "completed": 300},
+    }
+
+
+class TestAuthChecker:
+    def test_valid_artifact_passes(self):
+        assert check_auth_obj(_auth_obj()) == []
+
+    def test_conservation_violation_flagged(self):
+        obj = _auth_obj()
+        obj["arms"]["defended"]["legs"]["attack_flip"][
+            "integrity_rejects"] = 511
+        errs = check_auth_obj(obj)
+        assert any("conservation" in e for e in errs)
+
+    def test_defended_acceptance_flagged(self):
+        obj = _auth_obj()
+        leg = obj["arms"]["defended"]["legs"]["attack_forge"]
+        leg["accepts_new"] = 10
+        leg["integrity_rejects"] = 502
+        errs = check_auth_obj(obj)
+        assert any("ACCEPTED" in e for e in errs)
+
+    def test_imperfect_defended_integrity_flagged(self):
+        obj = _auth_obj()
+        obj["arms"]["defended"]["integrity"] = 0.999
+        obj["bench"]["value"] = 0.999
+        errs = check_auth_obj(obj)
+        assert any("!= 1.0" in e for e in errs)
+
+    def test_undegraded_undefended_flagged(self):
+        obj = _auth_obj()
+        obj["arms"]["undefended"]["integrity"] = 0.97
+        obj["bench"]["undefended_integrity"] = 0.97
+        errs = check_auth_obj(obj)
+        assert any("not degraded" in e for e in errs)
+
+    def test_overhead_above_budget_flagged(self):
+        obj = _auth_obj()
+        obj["overhead"]["ratio"] = 0.12
+        obj["bench"]["overhead_ratio"] = 0.12
+        errs = check_auth_obj(obj)
+        assert any("above the stated budget" in e for e in errs)
+
+    def test_loose_budget_flagged(self):
+        obj = _auth_obj()
+        obj["overhead"]["budget"] = 0.5
+        errs = check_auth_obj(obj)
+        assert any("ceiling" in e for e in errs)
+
+    def test_tiny_wall_overhead_not_gated(self):
+        # Below AUTH_OVERHEAD_MIN_WALL_S the ratio is scheduler noise
+        # (review finding: -0.5%..+17% run-to-run at the CI smoke
+        # shape) — recorded, never gated.
+        obj = _auth_obj()
+        obj["overhead"].update(verified_wall_s=0.056,
+                               unverified_wall_s=0.047,
+                               ratio=0.1915)
+        obj["bench"]["overhead_ratio"] = 0.1915
+        assert check_auth_obj(obj) == []
+
+    def test_fake_ratio_flagged(self):
+        obj = _auth_obj()
+        obj["overhead"]["ratio"] = 0.001
+        obj["bench"]["overhead_ratio"] = 0.001
+        errs = check_auth_obj(obj)
+        assert any("not reproducible" in e for e in errs)
+
+    def test_fabricated_crypto_figures_flagged(self):
+        obj = _auth_obj()
+        obj["signature"]["verifies_per_sec"] = 1234.5
+        errs = check_auth_obj(obj)
+        assert any("fabricated" in e for e in errs)
+
+    def test_fabricated_serve_signed_figures_flagged(self):
+        # The serve leg embeds the same stage stats — the null
+        # contract covers it too (review finding).
+        obj = _auth_obj()
+        obj["serve_signed"]["verify_wall_s"] = 0.123
+        errs = check_auth_obj(obj)
+        assert any("serve_signed" in e and "fabricated" in e
+                   for e in errs)
+
+    def test_off_arm_integrity_rejects_flagged(self):
+        obj = _auth_obj()
+        obj["arms"]["undefended"]["legs"]["attack_flip"] = _trace(
+            512, au=500, integ=12)
+        errs = check_auth_obj(obj)
+        assert any("verify plane OFF" in e for e in errs)
+
+    def test_main_dispatches_auth_kind(self, tmp_path, capsys):
+        from opendht_tpu.tools import check_trace as ct
+        path = tmp_path / "auth.json"
+        path.write_text(json.dumps(_auth_obj()))
+        assert ct.main([str(path)]) == 0
+        assert "auth OK" in capsys.readouterr().out
+
+
+class TestAuthBenchGate:
+    def test_quality_gates(self):
+        from opendht_tpu.tools.check_bench import check_bench_rows
+        base = {"metric": "swarm_auth_defended_integrity",
+                "value": 1.0, "undefended_integrity": 0.05,
+                "overhead_ratio": 0.03, "overhead_budget": 0.10,
+                "unverified_wall_s": 0.46,
+                "integrity_rejects": 1024, "platform": "cpu"}
+        cur = dict(base)
+        assert check_bench_rows(cur, base) == []
+        bad = dict(base, value=0.99)
+        assert any("!= 1.0" in e
+                   for e in check_bench_rows(bad, base))
+        bad = dict(base, integrity_rejects=0)
+        assert any("never fired" in e
+                   for e in check_bench_rows(bad, base))
+        bad = dict(base, overhead_ratio=0.2)
+        assert any("overhead" in e
+                   for e in check_bench_rows(bad, base))
+        bad = dict(base, undefended_integrity=0.9)
+        assert any("regressed" in e
+                   for e in check_bench_rows(bad, base))
+
+    def test_overhead_noise_floor_matches_check_trace(self):
+        # The two checkers share one wall floor: a tiny-wall row's
+        # noisy ratio gates in NEITHER (review finding — they must
+        # never disagree on the same artifact).
+        from opendht_tpu.tools.check_bench import check_bench_rows
+        base = {"metric": "swarm_auth_defended_integrity",
+                "value": 1.0, "undefended_integrity": 0.05,
+                "overhead_ratio": 0.2, "overhead_budget": 0.10,
+                "unverified_wall_s": 0.05,
+                "integrity_rejects": 1024, "platform": "cpu"}
+        assert check_bench_rows(dict(base), base) == []
+
+
+class TestStoreTraceExtension:
+    def test_zeros_and_add_carry_integrity_column(self):
+        z = StoreTrace.zeros()
+        assert len(z) == 6
+        s = z + z
+        assert int(jax.device_get(s.integrity_rejects)) == 0
+        assert "integrity_rejects" in z.to_dict()

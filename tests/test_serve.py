@@ -998,3 +998,117 @@ class TestHardWallSheds:
         assert n == 1
         hit, _, _ = eng.probe_cache(jnp.asarray(k))
         assert not hit[0] and hit[1]
+
+
+class TestPerKeyBuckets:
+    """Per-KEY token buckets layered under the class buckets (ISSUE 13
+    satellite — ROADMAP #1's named fairness follow-up): one hot key's
+    flood must die at its own bucket instead of draining the shared
+    class tokens, and the key map must stay bounded."""
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="per-key admission rate"):
+            AdmissionControl(rate=100, per_key_rate=0)
+        with pytest.raises(ValueError, match="per-key admission burst"):
+            AdmissionControl(rate=100, per_key_rate=5,
+                             per_key_burst=0.5)
+        with pytest.raises(ValueError, match="max_keys"):
+            AdmissionControl(rate=100, per_key_rate=5, max_keys=0)
+
+    def test_hot_key_starves_cold_without_per_key(self):
+        # The REGRESSION baseline: class buckets alone — the hot key
+        # drains the shared bucket and every cold key is refused.
+        ac = AdmissionControl(rate=10, burst=10, policy="shed")
+        hot = sum(ac.allow("all", 0.0, key=b"hot")
+                  for _ in range(100))
+        assert hot == 10
+        assert not any(ac.allow("all", 0.0, key=b"c%d" % i)
+                       for i in range(5))
+
+    def test_per_key_buckets_keep_cold_keys_admitted(self):
+        ac = AdmissionControl(rate=10, burst=10, policy="shed",
+                              per_key_rate=1, per_key_burst=2)
+        hot = sum(ac.allow("all", 0.0, key=b"hot")
+                  for _ in range(100))
+        # The hot key gets exactly its own burst, leaving class tokens
+        # for everyone else — cold keys fully admitted.
+        assert hot == 2
+        assert all(ac.allow("all", 0.0, key=b"c%d" % i)
+                   for i in range(5))
+
+    def test_key_map_lru_capped(self):
+        ac = AdmissionControl(rate=1000, burst=1000, policy="shed",
+                              per_key_rate=5, max_keys=4)
+        for i in range(10):
+            ac.allow("all", 0.0, key=b"k%d" % i)
+        assert len(ac._key_buckets) == 4
+        assert ac.key_evictions == 6
+        # Re-accessing a surviving key must not evict (LRU touch).
+        ac.allow("all", 0.0, key=b"k9")
+        assert ac.key_evictions == 6
+
+    def test_key_ignored_without_per_key_rate(self):
+        ac = AdmissionControl(rate=5, burst=5, policy="shed")
+        assert all(ac.allow("all", 0.0, key=b"x") for _ in range(5))
+        assert not ac.allow("all", 0.0, key=b"x")
+        assert len(ac._key_buckets) == 0
+
+    def test_queue_policy_rejects_per_key(self):
+        # Queue is head-of-line by contract: a key-dry head would
+        # block every request behind it — the exact starvation the
+        # key buckets exist to remove (review finding, pinned).
+        with pytest.raises(ValueError, match="queue"):
+            AdmissionControl(rate=100, policy="queue", per_key_rate=5)
+
+    def test_refusal_charges_neither_bucket(self):
+        # Atomic check-then-spend (review finding): a class-dry
+        # refusal must not drain the key bucket (a retried request
+        # would otherwise exhaust its key tokens without ever being
+        # admitted), and a key-dry refusal must not drain the class
+        # bucket.
+        ac = AdmissionControl(rate=1, burst=1, policy="shed",
+                              per_key_rate=100, per_key_burst=100)
+        assert ac.allow("all", 0.0, key=b"k")     # spends class token
+        kt0 = ac._key_buckets[b"k"].tokens
+        for _ in range(10):                       # class dry: refused
+            assert not ac.allow("all", 0.0, key=b"k")
+        assert ac._key_buckets[b"k"].tokens == kt0
+        ac2 = AdmissionControl(rate=100, burst=100, policy="shed",
+                               per_key_rate=1, per_key_burst=1)
+        assert ac2.allow("all", 0.0, key=b"k")    # spends key token
+        ct0 = ac2._buckets["all"].tokens
+        for _ in range(10):                       # key dry: refused
+            assert not ac2.allow("all", 0.0, key=b"k")
+        assert ac2._buckets["all"].tokens == ct0
+
+    def test_open_loop_hot_flood_sheds_cold_serves(self, swarm):
+        """End-to-end hot-starves-cold regression through the serve
+        loop: one key floods at ~50x its per-key quota while cold keys
+        trickle — every cold request must be admitted and complete."""
+        rng = np.random.default_rng(11)
+        n_hot, n_cold = 400, 20
+        ts = np.sort(rng.uniform(0.0, 1.0, n_hot + n_cold))
+        pool = np.asarray(jax.random.bits(jax.random.PRNGKey(31),
+                                          (n_cold + 1, 5), jnp.uint32))
+        cold_slots = set(
+            rng.choice(n_hot + n_cold, size=n_cold, replace=False))
+        keys = np.zeros((n_hot + n_cold, 5), np.uint32)
+        ci = 0
+        for i in range(n_hot + n_cold):
+            if i in cold_slots:
+                ci += 1
+                keys[i] = pool[ci]
+            else:
+                keys[i] = pool[0]
+        ac = AdmissionControl(rate=100000, burst=100000, policy="shed",
+                              per_key_rate=8, per_key_burst=8)
+        c1, s1 = virtual_clock()
+        eng = ServeEngine(swarm, CFG, slots=128, admit_cap=32)
+        rep = serve_open_loop(eng, ts, keys, jax.random.PRNGKey(3),
+                              burst=2, duration=1.0, admission=ac,
+                              clock=c1, sleep=s1)
+        done = set(int(r) for r in rep["request"])
+        assert cold_slots <= done, "a cold key was starved"
+        assert rep["shed"] > 0.5 * n_hot
+        assert rep["admitted"] == rep["completed"] + rep["expired"] \
+            + rep["in_flight"]

@@ -259,9 +259,9 @@ class TestWorkClassPlane:
         keys = jax.random.bits(jax.random.PRNGKey(1), (16, 5),
                                jnp.uint32)
         cls = np.array([0, 1] * 8, np.int32)
-        st = eng.admit_serve(st, keys,
-                             jnp.arange(16, dtype=jnp.int32), cls,
-                             jax.random.PRNGKey(2), 0)
+        st, _hit, _hf, _hh = eng.admit_serve(
+            st, keys, jnp.arange(16, dtype=jnp.int32), cls,
+            jax.random.PRNGKey(2), 0)
         *_, counts = jax.device_get(
             _soak_snapshot(swarm, CFG, st, eng.wc))
         assert counts[0] == 8 and counts[1] == 8
@@ -604,3 +604,111 @@ class TestTimelineUnit:
         assert "dht_soak_slot_rounds_total" in text
         assert "dht_soak_requests_total" in text
         assert "dht_soak_occupancy_ratio" in text
+
+
+class TestSoakCache:
+    """The probe-fused soak cache (ISSUE 13 satellite — ROADMAP #1's
+    soak follow-up): cache_slots was provisioning-only, now the soak
+    admission consults it.  Contracts: a COLD cache is bit-identical
+    to cache-off on a shared virtual clock (pure overlay), hits
+    complete instantly without slots or work-class tags, and every
+    read admission is exactly one of hit or miss."""
+
+    def test_cold_cache_bit_identical_to_cache_off(self, swarm,
+                                                   schedule):
+        ts, keys, klass = schedule
+        c1, s1 = virtual_clock()
+        soak0 = SoakEngine(swarm, CFG, slots=128, admit_cap=32)
+        r0 = soak_open_loop(soak0, ts, keys, jax.random.PRNGKey(3),
+                            klass=klass, burst=2, duration=2.0,
+                            maintenance=False, clock=c1, sleep=s1)
+        c2, s2 = virtual_clock()
+        soak1 = SoakEngine(swarm, CFG, slots=128, admit_cap=32,
+                           cache_slots=256)
+        soak1.serve.cache_fill_enabled = False   # permanently cold
+        r1 = soak_open_loop(soak1, ts, keys, jax.random.PRNGKey(3),
+                            klass=klass, burst=2, duration=2.0,
+                            maintenance=False, clock=c2, sleep=s2)
+        for k in ("admitted", "completed", "expired", "in_flight",
+                  "rounds", "elapsed_s", "queue_depth_mean",
+                  "slot_occupancy_frac"):
+            assert r0[k] == r1[k], k
+        for k in ("request", "latency_s", "hops", "service_rounds",
+                  "found_nonempty"):
+            assert np.array_equal(np.asarray(r0[k]),
+                                  np.asarray(r1[k])), k
+        assert r0["burst_marks"] == r1["burst_marks"]
+        assert r1["cache_hits"] == 0
+        assert r1["cache_misses"] == r1["admitted"]
+        assert r1["wclass_mismatches"] == 0
+
+    def test_hits_complete_instantly_and_conserve(self, swarm,
+                                                  schedule):
+        ts, keys, klass = schedule
+        c2, s2 = virtual_clock()
+        soak = SoakEngine(swarm, CFG, slots=128, admit_cap=32,
+                          cache_slots=512)
+        rep = soak_open_loop(soak, ts, keys, jax.random.PRNGKey(3),
+                             klass=klass, burst=2, duration=2.0,
+                             maintenance=False, clock=c2, sleep=s2)
+        # The Zipf head repeats keys, so fills must produce hits.
+        assert rep["cache_hits"] > 0
+        assert rep["cache_hits"] + rep["cache_misses"] \
+            == rep["lifecycle_by_class"]["read"]["admitted"]
+        # A hit is a zero-round completion; every hit is booked as a
+        # read completion, and conservation holds per class.
+        sr = np.asarray(rep["service_rounds"])
+        assert int((sr == 0).sum()) == rep["cache_hits"]
+        lc = rep["lifecycle_by_class"]
+        for cls in WORK_CLASS_NAMES:
+            d = lc[cls]
+            assert d["admitted"] == d["completed"] + d["expired"] \
+                + d["in_flight"], cls
+        assert rep["wclass_mismatches"] == 0
+        assert rep["completed"] > 0
+
+    def test_cache_rides_maintenance_and_write_invalidation(
+            self, swarm):
+        """Cache on + writes + republish maintenance in one loop: the
+        write flush bumps the epoch (announce-side invalidation), the
+        work-class plane never drifts, and read hit/miss accounting
+        stays exact next to maintenance admissions (which are never
+        probed)."""
+        scfg = StoreConfig(slots=4, listen_slots=2, max_listeners=64,
+                           payload_words=0)
+        store = empty_store(CFG.n_nodes, scfg)
+        p = 64
+        put_keys = jax.random.bits(jax.random.PRNGKey(41), (p, 5),
+                                   jnp.uint32)
+        store, _ = announce(swarm, CFG, store, scfg, put_keys,
+                            jnp.arange(p, dtype=jnp.uint32) + 1,
+                            jnp.ones((p,), jnp.uint32), 0,
+                            jax.random.PRNGKey(42))
+        ts, keys, klass, ops, lo, hi = mixed_events(
+            rate=300, duration=2.0, key_pool=128, zipf_s=1.1, seed=9,
+            write_frac=0.3)
+        c1, s1 = virtual_clock()
+        soak = SoakEngine(swarm, CFG, slots=128, admit_cap=32,
+                          scfg=scfg, store=store, cache_slots=256,
+                          soak_cfg=SoakConfig(repub_period_s=0.5,
+                                              maint_cap=64,
+                                              maint_slot_frac=0.25))
+        ep0 = int(jax.device_get(soak.serve.cache.epoch))
+        rep = soak_open_loop(soak, ts, keys, jax.random.PRNGKey(3),
+                             klass=klass, ops=ops, burst=2,
+                             duration=2.0, maintenance=True,
+                             clock=c1, sleep=s1)
+        assert rep["wclass_mismatches"] == 0
+        assert rep["cache_hits"] + rep["cache_misses"] \
+            == rep["lifecycle_by_class"]["read"]["admitted"]
+        # Writes flushed -> the epoch moved (cached answers retired).
+        assert rep["write_flushes"] > 0
+        assert int(jax.device_get(soak.serve.cache.epoch)) \
+            == ep0 + rep["write_flushes"]
+        # Maintenance ran beside the cache without perturbing class
+        # conservation.
+        assert rep["repub_sweeps"], "no republish sweep closed"
+        for cls in WORK_CLASS_NAMES:
+            d = rep["lifecycle_by_class"][cls]
+            assert d["admitted"] == d["completed"] + d["expired"] \
+                + d["in_flight"], cls
