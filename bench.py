@@ -100,14 +100,19 @@ def main():
                          "round, the pre-ladder engine — for A/B "
                          "attribution)")
     ap.add_argument("--merge-impl",
-                    choices=("auto", "xla", "xla-sort", "pallas"),
+                    choices=("auto", "xla", "xla-sort", "pallas",
+                             "pallas-round"),
                     default="auto",
                     help="round-merge micro-architecture (auto = fused "
-                         "Pallas kernel on TPU, XLA rank-merge "
-                         "elsewhere; xla-sort = the pre-round-9 "
-                         "two-pass sorted merge — for A/B "
-                         "attribution; pallas off-TPU runs the "
-                         "interpreter and is for tests only)")
+                         "Pallas kernel on TPU, XLA narrowed-plane "
+                         "rank-merge + width ladder elsewhere; "
+                         "xla-sort = the pre-round-9 two-pass sorted "
+                         "merge — for A/B attribution; pallas = the "
+                         "merge-only fused kernel; pallas-round = the "
+                         "whole-round fused kernel (gather + decode + "
+                         "merge VMEM-resident, local aug-table engine "
+                         "only); either pallas variant off-TPU runs "
+                         "the interpreter and is for tests only)")
     ap.add_argument("--recall-sample", type=int, default=512)
     ap.add_argument("--mode",
                     choices=("lookups", "putget", "churn", "crawl",
@@ -799,6 +804,47 @@ def main():
         phases["round_wall_p50"] = round_full_p50 or round_p50
         ledger.round_phases = phases
         ledger.attr_compile_count = attr_compile_count
+        # Round-18 width-ladder attribution: advance a probe batch to
+        # a TAIL-round state (where the live-slot watermark actually
+        # shrinks), pick the rung the burst loop would, and price the
+        # same telescoping prefixes with the merge laddered —
+        # prefix-equivalence asserted inside measure_round_phases, the
+        # table validated by check_trace (self-consistent against its
+        # own fused-round wall; the full-width table above keeps the
+        # round_wall_p50 cross-check).
+        if resolve_merge_impl(cfg) == "xla":
+            from opendht_tpu.models.swarm import (_pending_and_wneed,
+                                                  _sample_origins,
+                                                  lookup_init,
+                                                  lookup_step)
+            from opendht_tpu.ops.xor_metric import pick_merge_width
+            resp_w = cfg.alpha * 2 * cfg.bucket_k
+            # Same key + targets as the attribution pass below, so the
+            # probe's state evolution (and hence the rung chosen at
+            # round `adv`) is EXACTLY the state the laddered table
+            # measures — a rung probed on a different trajectory could
+            # overflow there and silently price the guard's full
+            # branch.
+            pst = lookup_init(swarm, cfg, chunks[0], _sample_origins(
+                jax.random.PRNGKey(77), swarm.alive,
+                chunks[0].shape[0]))
+            rung, adv = None, 0
+            for r in range(cfg.max_steps):
+                pst = lookup_step(swarm, cfg, pst)
+                wneed = int(jax.device_get(
+                    _pending_and_wneed(pst, cfg)[1]))
+                if wneed == 0:
+                    break
+                rung = pick_merge_width(wneed, resp_w,
+                                        2 * cfg.bucket_k)
+                if rung is not None:
+                    adv = r + 1
+                    break
+            if rung is not None:
+                ledger.round_phases_laddered = measure_round_phases(
+                    swarm, cfg, chunks[0], jax.random.PRNGKey(77),
+                    repeats=max(2, args.repeat), merge_w=rung,
+                    advance_rounds=adv)
 
     # Tier-2 attribution: where the fused Pallas round kernel is the
     # resolved hot path (TPU), also time the XLA rank-merge variant so
@@ -898,6 +944,12 @@ def main():
         out["rounds_dispatched"] = rd
         out["mean_active_frac"] = (round(rr / full_rr, 4)
                                    if full_rr else None)
+        mws = sorted({mw for s in chunk_stats
+                      for mw in s.get("merge_widths", ())})
+        if mws:
+            # Distinct merge-width rungs the round-18 ladder dispatched
+            # (full width included) — the width-pruning attribution.
+            out["merge_widths"] = mws
     if recall_error is not None:
         out["recall_error"] = recall_error
     if attr_compile_count is not None:

@@ -47,9 +47,12 @@ from ..ops.xor_metric import (
     N_LIMBS,
     closest_nodes_batched,
     lex_searchsorted,
+    merge_ladder_widths,
     merge_shortlists_d0,
+    pick_merge_width,
     prefix_len32,
     rank_merge_round_d0,
+    rank_merge_round_d0_w,
 )
 from ..utils.hostdevice import dev_i32
 
@@ -166,6 +169,16 @@ class SwarmConfig(NamedTuple):
     #   "pallas"   — the fused dedup+merge+quorum Pallas kernel
     #                (ops.pallas_kernels.merge_round_pallas); interpret
     #                mode off-TPU, so only tests should force it there.
+    #   "pallas-round" — the WHOLE-ROUND fused Pallas kernel
+    #                (ops.pallas_kernels.fused_round_pallas): the
+    #                frontier stays VMEM-resident across table gather
+    #                (in-kernel row DMAs) + window decode +
+    #                queried/evict update + merge + quorum check.
+    #                Local plain engine with augmented tables only;
+    #                traced/chaos/routed engines degrade to the
+    #                merge-only kernel.  Opt-in (never auto-resolved)
+    #                until a TPU measurement exists; interpret mode
+    #                off-TPU is for tests only, like "pallas".
     merge_impl: str = "auto"
 
     @classmethod
@@ -200,7 +213,7 @@ class SwarmConfig(NamedTuple):
 _swarmconfig_new = SwarmConfig.__new__
 
 
-MERGE_IMPLS = ("auto", "xla", "xla-sort", "pallas")
+MERGE_IMPLS = ("auto", "xla", "xla-sort", "pallas", "pallas-round")
 
 
 def _swarmconfig_checked_new(cls, *args, **kw):
@@ -949,7 +962,8 @@ def init_impl(ids: jax.Array, respond, cfg: SwarmConfig,
 def step_impl(ids: jax.Array, alive: jax.Array, respond,
               cfg: SwarmConfig, st: LookupState,
               trace: LookupTrace | None = None,
-              rnd: jax.Array | None = None, done_base: int = 0):
+              rnd: jax.Array | None = None, done_base: int = 0,
+              merge_w: int | None = None):
     """Shared lock-step solicitation round (vectorized ``searchStep``,
     src/dht.cpp:1343-1464): select α unqueried, solicit via
     ``respond``, merge responses, re-sort, check sync quorum.
@@ -961,7 +975,10 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     the compaction ladder excluded from this dispatch (they sit
     outside ``st`` but are still done) — added to the done GAUGE so a
     truncated dispatch reports the same batch-wide convergence curve
-    as a full-width one."""
+    as a full-width one.  ``merge_w`` (static) is the response-width
+    ladder rung the rank merge is priced at — guarded in-jit, so any
+    value is bit-identical to ``None`` (full width); see
+    :func:`opendht_tpu.ops.xor_metric.rank_merge_round_d0_w`."""
     # Finished lookups stop soliciting: besides wasting gathers, their
     # traffic would consume bounded all_to_all capacity and could
     # starve still-active queries on a hot shard.
@@ -971,7 +988,7 @@ def step_impl(ids: jax.Array, alive: jax.Array, respond,
     resp, resp_d0, answered = respond(st.targets, sel, sel_d0)  # [L,A*2K]
     return _merge_round(st, cfg, sel, sel_pos, sel_alive, answered,
                         resp, resp_d0, trace=trace, rnd=rnd,
-                        done_base=done_base)
+                        done_base=done_base, merge_w=merge_w)
 
 
 def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
@@ -979,7 +996,8 @@ def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
                  answered: jax.Array, resp: jax.Array,
                  resp_d0: jax.Array,
                  trace: LookupTrace | None = None,
-                 rnd: jax.Array | None = None, done_base: int = 0):
+                 rnd: jax.Array | None = None, done_base: int = 0,
+                 merge_w: int | None = None):
     """Round tail shared by the plain and chaos engines: fold the α
     solicitations' outcomes into the shortlist, merge, re-sort, check
     the sync quorum.  ONE copy of the merge/eviction/done semantics,
@@ -1018,15 +1036,19 @@ def _merge_round(st: LookupState, cfg: SwarmConfig, sel: jax.Array,
     fr_dist = jnp.where(evict, jnp.uint32(UINT32_MAX), st.dist)
     impl = resolve_merge_impl(cfg)
     done_merge = None
-    if impl == "pallas":
+    if impl in ("pallas", "pallas-round"):
+        # "pallas-round" reaching THIS dispatch means the engine cannot
+        # fuse the whole round (traced/chaos/routed paths, plain
+        # tables) — it degrades to the merge-only kernel; the local
+        # plain engine intercepts it earlier (lookup_step).
         from ..ops.pallas_kernels import merge_round_pallas
         f_idx, f_dist, f_q, done_merge = merge_round_pallas(
             idx, fr_dist, queried, resp, resp_d0,
             quorum=cfg.quorum, keep=cfg.search_width)
     elif impl == "xla":
-        f_idx, f_dist, f_q = rank_merge_round_d0(
+        f_idx, f_dist, f_q = rank_merge_round_d0_w(
             idx, fr_dist, queried, resp, resp_d0,
-            keep=cfg.search_width)
+            keep=cfg.search_width, merge_w=merge_w)
     else:                                               # "xla-sort"
         cand_idx = jnp.concatenate([idx, resp], axis=1)
         cand_dist = jnp.concatenate([fr_dist, resp_d0], axis=1)
@@ -1137,28 +1159,91 @@ def lookup_init(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
                      targets, origins)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "merge_w"))
 def lookup_step(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
-                rnd: jax.Array | None = None) -> LookupState:
+                rnd: jax.Array | None = None,
+                merge_w: int | None = None) -> LookupState:
     """One plain round.  ``rnd`` (the loop's round index) is only
     needed — and only passed by the loops — when the state carries the
     lifecycle plane; without it the program is byte-identical to the
-    pre-lifecycle step."""
+    pre-lifecycle step.  ``merge_w`` (static, loops only) is the
+    response-width rung the rank merge is priced at — ``None`` keeps
+    the exact pre-ladder program; any value is bit-identical (in-jit
+    guarded)."""
+    if resolve_merge_impl(cfg) == "pallas-round":
+        return _fused_round_step(swarm, cfg, st, rnd=rnd)
     return step_impl(swarm.ids, swarm.alive, _local_respond(swarm, cfg),
-                     cfg, st, rnd=rnd)
+                     cfg, st, rnd=rnd, merge_w=merge_w)
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+@partial(jax.jit, static_argnames=("cfg", "merge_w"),
+         donate_argnums=(2,))
 def _lookup_step_d(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
-                   rnd: jax.Array | None = None) -> LookupState:
+                   rnd: jax.Array | None = None,
+                   merge_w: int | None = None) -> LookupState:
     """:func:`lookup_step` with the state DONATED — the burst-loop
     carry is single-owner, so XLA reuses its buffers in place instead
     of holding input+output copies across every round (and across the
     compaction repack).  Internal to the burst loops: external callers
     keep the non-donating :func:`lookup_step`, whose inputs stay
     valid."""
+    if resolve_merge_impl(cfg) == "pallas-round":
+        return _fused_round_step(swarm, cfg, st, rnd=rnd)
     return step_impl(swarm.ids, swarm.alive, _local_respond(swarm, cfg),
-                     cfg, st, rnd=rnd)
+                     cfg, st, rnd=rnd, merge_w=merge_w)
+
+
+def _fused_round_step(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+                      rnd: jax.Array | None = None) -> LookupState:
+    """One plain round through the WHOLE-ROUND fused Pallas kernel
+    (``merge_impl="pallas-round"``): the α-select scalars are prepared
+    by a thin XLA prelude, then table-row gather (in-kernel DMAs),
+    window decode, the queried/evict update, the rank merge and the
+    quorum check all run with the frontier resident in VMEM —
+    :func:`opendht_tpu.ops.pallas_kernels.fused_round_pallas`.
+
+    Semantics are EXACTLY :func:`step_impl` over the local augmented
+    respond — asserted bit-identical (results, hops, done) in
+    ``tests/test_merge_equivalence.py`` under interpret mode.  Only
+    the local plain engine takes this path; augmented tables are
+    required (the kernel's row DMAs and window decode are the aug
+    layout's).
+    """
+    if swarm.tables.dtype != jnp.uint16:
+        raise ValueError(
+            "merge_impl='pallas-round' requires augmented tables "
+            "(SwarmConfig.aug_tables=True): the fused round kernel "
+            "gathers and decodes the u16 bucket-row layout in-kernel")
+    from ..ops.pallas_kernels import fused_round_pallas
+    n, b_total = cfg.n_nodes, cfg.n_buckets
+    sel, sel_d0, sel_pos = _select_alpha(st, cfg)               # [L,A]
+    sel = jnp.where(st.done[:, None], -1, sel)
+    safe = jnp.clip(sel, 0, n - 1)
+    valid_sel = sel >= 0
+    sel_alive = valid_sel & swarm.alive[safe]
+    # Local respond delivers to every live target (answered ≡ alive).
+    q_hit = valid_sel & sel_alive
+    e_hit = valid_sel & ~sel_alive
+    w0 = jnp.clip(prefix_len32(sel_d0), 0, b_total - 2)
+    f_idx, f_dist, f_q, done_merge = fused_round_pallas(
+        swarm.tables, st.targets[:, 0], st.idx, st.dist, st.queried,
+        safe, sel_d0, sel_pos, w0, q_hit, e_hit,
+        bucket_k=cfg.bucket_k, quorum=cfg.quorum,
+        keep=cfg.search_width)
+    active = ~st.done & jnp.any(sel >= 0, axis=1)
+    done = st.done | done_merge
+    completed = st.completed_round
+    if completed is not None:
+        if rnd is None:
+            raise ValueError(
+                "lifecycle tracking needs the round index: pass rnd= "
+                "to the step (the loops do when the fields are present)")
+        completed = jnp.where(done & ~st.done,
+                              jnp.asarray(rnd, jnp.int32), completed)
+    return LookupState(
+        targets=st.targets, idx=f_idx, dist=f_dist, queried=f_q,
+        done=done, hops=st.hops + active.astype(jnp.int32),
+        admitted_round=st.admitted_round, completed_round=completed)
 
 
 def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
@@ -1233,9 +1318,10 @@ def lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         return LookupResult(found=_finalize(swarm.ids, st, cfg),
                             hops=st.hops, done=st.done)
     st, _, order = run_compacted_burst_loop(
-        lambda s, ex, r, hidden: (_lookup_step_d(swarm, cfg, s,
-                                                 rnd_of(r)), ex),
-        st, cfg, stats=stats)
+        lambda s, ex, r, hidden, mw: (_lookup_step_d(
+            swarm, cfg, s, rnd_of(r), merge_w=mw), ex),
+        st, cfg, stats=stats,
+        width_ladder=resolve_merge_impl(cfg) == "xla")
     if track_lifecycle and stats is not None:
         stats["admitted_round"] = _scatter_rows(st.admitted_round, order)
         stats["completed_round"] = _scatter_rows(st.completed_round,
@@ -1403,6 +1489,23 @@ def _zeros_i32(n: int) -> jax.Array:
     return jnp.zeros((n,), jnp.int32)
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def _pending_and_wneed(st: LookupState, cfg: SwarmConfig):
+    """Fused per-burst readback pair: the pending count that steers the
+    ROW ladder, and the live-slot WATERMARK that steers the merge-width
+    ladder — the widest pending row's next-round solicitation count
+    times the 2K response block, i.e. an upper bound on next round's
+    live response columns (dead solicitations still occupy a block but
+    return only invalid slots, which the merge prices as empty).  Two
+    scalars, ONE device_get — the readback the burst loop already
+    pays."""
+    pending = jnp.sum(~st.done)
+    unq = jnp.sum(((st.idx >= 0) & ~st.queried).astype(jnp.int32),
+                  axis=1)
+    blocks = jnp.where(st.done, 0, jnp.minimum(cfg.alpha, unq))
+    return pending, jnp.max(blocks) * (2 * cfg.bucket_k)
+
+
 @jax.jit
 def _scatter_rows(x: jax.Array, order: jax.Array) -> jax.Array:
     """Return rows to their pre-compaction batch positions (``order[i]``
@@ -1421,27 +1524,42 @@ def _finalize_scattered(ids: jax.Array, st: LookupState,
 
 
 def run_compacted_burst_loop(step_fn, st: LookupState, cfg: SwarmConfig,
-                             extras=(), stats: dict | None = None):
+                             extras=(), stats: dict | None = None,
+                             width_ladder: bool = False):
     """:func:`run_burst_loop` with active-set compaction.
 
-    ``step_fn(st, extras, rnd, hidden)`` advances one round and returns
-    ``(st, extras)``; ``hidden`` (a Python int, ≤ log2 L distinct
-    values) is the count of finished rows excluded from the dispatched
-    prefix — traced steps add it to the done gauge.  ``extras`` is an
-    opaque tuple riding the carry at full shape (chaos strike vectors,
-    traces); only the ``LookupState`` is compacted.  The done-check
-    readback the burst loop already pays doubles as the pending count
-    that drives the shape ladder, so compaction adds ZERO extra host
-    syncs.  Returns ``(full_state, extras, order)`` — ``order[i]`` is
-    row ``i``'s original batch position, for the finalize scatter-back.
+    ``step_fn(st, extras, rnd, hidden, merge_w)`` advances one round
+    and returns ``(st, extras)``; ``hidden`` (a Python int, ≤ log2 L
+    distinct values) is the count of finished rows excluded from the
+    dispatched prefix — traced steps add it to the done gauge;
+    ``merge_w`` is the response-width rung the merge should be priced
+    at (``None`` = full width; steps that don't ladder just drop it).
+    ``extras`` is an opaque tuple riding the carry at full shape
+    (chaos strike vectors, traces); only the ``LookupState`` is
+    compacted.  The done-check readback the burst loop already pays
+    doubles as the pending count that drives the shape ladder — and,
+    with ``width_ladder`` on, as the live-slot WATERMARK that drives
+    the merge-width ladder (one fused readback, still zero extra host
+    syncs).  The watermark is not monotone, so a stale rung is
+    corrected in-jit by the merge's overflow guard
+    (:func:`opendht_tpu.ops.xor_metric.rank_merge_round_d0_w`) —
+    bit-identical either way.  Returns ``(full_state, extras, order)``
+    — ``order[i]`` is row ``i``'s original batch position, for the
+    finalize scatter-back.
 
     ``stats`` (optional dict) receives ``rounds_dispatched``,
     ``dispatched_row_rounds``, ``mean_active_frac`` and the distinct
-    ``widths`` used — the bench's attribution fields.
+    ``widths`` used — the bench's attribution fields — plus
+    ``merge_widths`` when the width ladder engages.
     """
     l = st.done.shape[0]
     order = jnp.arange(l, dtype=jnp.int32)
     full, sub, w = st, st, l
+    resp_w = cfg.alpha * 2 * cfg.bucket_k
+    ladder = (merge_ladder_widths(resp_w, 2 * cfg.bucket_k)
+              if width_ladder else [resp_w])
+    merge_w = None
+    merge_widths = []
     # First burst SHORTENED vs the uncompacted loop's calibrated
     # convergence depth: the done gauge crosses ~90 % two rounds
     # before the burst exit (measured 100k/1M/10M pending-by-round),
@@ -1463,19 +1581,35 @@ def run_compacted_burst_loop(step_fn, st: LookupState, cfg: SwarmConfig,
         n = min(burst, cfg.max_steps - rounds)
         tb = time.perf_counter() if timing else 0.0
         for _ in range(n):
-            sub, extras = step_fn(sub, extras, rounds, l - w)
+            sub, extras = step_fn(sub, extras, rounds, l - w, merge_w)
             rounds += 1
             row_rounds += w
         if w not in widths:
             widths.append(w)
+        if merge_w not in merge_widths:
+            merge_widths.append(merge_w)
         # graftlint: disable=sync-in-loop (per-burst pending readback steers the ladder width — amortized over >=2 device rounds)
-        pending = int(jax.device_get(jnp.sum(~sub.done)))
+        pending, wneed = (int(x) for x in jax.device_get(
+            _pending_and_wneed(sub, cfg)))
         if timing:
             stats.setdefault("burst_walls", []).append(
                 (time.perf_counter() - tb, n))
         if pending == 0:
             break
+        # Tail bursts stay 2 rounds: a 1-round tail was measured 13%
+        # SLOWER on the gate leg — the per-round readback serializes
+        # host dispatch against device execution, costing more than
+        # the overshoot round it saves.
         burst = 2
+        if len(ladder) > 1:
+            # Merge-width rung for the NEXT burst from the live-slot
+            # watermark: the widest pending row can solicit at most
+            # ``wneed/2K`` nodes next round, so its response block's
+            # live columns are bounded by ``wneed`` — the in-jit guard
+            # covers the non-monotone case where a later round in the
+            # burst regrows past the rung.
+            merge_w = pick_merge_width(wneed, resp_w,
+                                       2 * cfg.bucket_k)
         w_new = _ladder_width(pending, l)
         if w_new < w:
             if w == l:
@@ -1491,6 +1625,9 @@ def run_compacted_burst_loop(step_fn, st: LookupState, cfg: SwarmConfig,
         stats["mean_active_frac"] = (
             round(row_rounds / (rounds * l), 4) if rounds else 0.0)
         stats["widths"] = widths
+        if width_ladder:
+            stats["merge_widths"] = [resp_w if mw is None else mw
+                                     for mw in merge_widths]
     return full, extras, order
 
 
@@ -1501,18 +1638,23 @@ def traced_lookup_step(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
                      cfg, st, trace=trace, rnd=rnd)
 
 
-@partial(jax.jit, static_argnames=("cfg", "done_base"),
+@partial(jax.jit, static_argnames=("cfg", "done_base", "merge_w"),
          donate_argnums=(2,))
 def _traced_lookup_step_d(swarm: Swarm, cfg: SwarmConfig,
                           st: LookupState, trace: LookupTrace,
-                          rnd: jax.Array, done_base: int = 0):
+                          rnd: jax.Array, done_base: int = 0,
+                          merge_w: int | None = None):
     """Donated-carry :func:`traced_lookup_step` for the compacted burst
     loop; ``done_base`` folds the ladder-hidden finished rows into the
     done gauge (one static value per ladder width).  The trace is NOT
     donated: it is [max_steps]-tiny, and ``empty_lookup_trace`` aliases
-    one zeros buffer across its fields (double-donation)."""
+    one zeros buffer across its fields (double-donation).  ``merge_w``
+    is the merge width rung (guarded, bit-identical — the traced gate
+    leg must ride the same ladder as the plain engine or the recorded
+    rate would not)."""
     return step_impl(swarm.ids, swarm.alive, _local_respond(swarm, cfg),
-                     cfg, st, trace=trace, rnd=rnd, done_base=done_base)
+                     cfg, st, trace=trace, rnd=rnd, done_base=done_base,
+                     merge_w=merge_w)
 
 
 def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
@@ -1556,13 +1698,14 @@ def traced_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         return (LookupResult(found=_finalize(swarm.ids, st, cfg),
                              hops=st.hops, done=st.done), trace)
 
-    def step(s, ex, r, hidden):
+    def step(s, ex, r, hidden, mw):
         s, tr = _traced_lookup_step_d(swarm, cfg, s, ex[0],
-                                      dev_i32(r), hidden)
+                                      dev_i32(r), hidden, merge_w=mw)
         return s, (tr,)
 
     st, (trace,), order = run_compacted_burst_loop(
-        step, st, cfg, extras=(trace,), stats=stats)
+        step, st, cfg, extras=(trace,), stats=stats,
+        width_ladder=resolve_merge_impl(cfg) == "xla")
     if track_lifecycle and stats is not None:
         stats["admitted_round"] = _scatter_rows(st.admitted_round, order)
         stats["completed_round"] = _scatter_rows(st.completed_round,
@@ -2029,7 +2172,10 @@ def chaos_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         # _censor_convicted, there as here).
         prev = {"strikes": strikes}
 
-        def step(s, ex, r, hidden):
+        def step(s, ex, r, hidden, mw):
+            # The chaos engine keeps full-width merges (mw unused): it
+            # is a fault harness, not the perf-gate path, and its
+            # defense planes dominate the round anyway.
             prev["strikes"] = ex[0]
             out = _chaos_step_d(swarm, cfg, faults, s, ex[0],
                                 dev_i32(r), byz_aux,

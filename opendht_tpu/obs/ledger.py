@@ -234,6 +234,12 @@ class CostLedger:
         self.kernels: Dict[str, dict] = {}
         self.spans: List[dict] = []
         self.round_phases: Optional[dict] = None
+        # Round-18 width-ladder attribution: the same telescoping
+        # prefix table measured at a tail-round state with the merge
+        # priced at a ladder rung (validated for prefix equivalence and
+        # self-consistency by check_trace; the ±10% round_wall_p50
+        # cross-check applies to the FULL-WIDTH table only).
+        self.round_phases_laddered: Optional[dict] = None
         self.repub_profile: Optional[dict] = None
         self.attr_compile_count: Optional[int] = None
         self._hbm_peak_live = 0
@@ -387,6 +393,8 @@ class CostLedger:
                 for s in self.spans]
         if self.round_phases is not None:
             out["round_phases"] = self.round_phases
+        if self.round_phases_laddered is not None:
+            out["round_phases_laddered"] = self.round_phases_laddered
         if self.repub_profile is not None:
             out["repub_profile"] = self.repub_profile
         if self.attr_compile_count is not None:
@@ -480,7 +488,7 @@ def instrumented_entry_points(ledger: CostLedger,
 # phase plane: the round sub-phase A/B pass
 # ---------------------------------------------------------------------------
 
-def _round_prefix_fn(upto: str):
+def _round_prefix_fn(upto: str, merge_w: int | None = None):
     """Build the jitted prefix program running the round's phases up to
     (and including) ``upto``.
 
@@ -493,6 +501,17 @@ def _round_prefix_fn(upto: str):
     decomposition can never silently drift from the shipped round.
     Every intermediate a later phase consumes is returned, so no
     phase's work is dead code.
+
+    ``merge_w`` threads the round-18 merge-width rung into the merge
+    phase (``rank_merge_round_d0_w``'s guarded laddered planes) so the
+    attribution can price the narrowed merge the engine actually runs
+    in tail bursts — the laddered prefix is asserted bit-equal to
+    ``lookup_step(merge_w=...)`` like the full-width one.  A
+    ``merge_impl="pallas-round"`` config attributes through the
+    UNFUSED composition (its phases don't exist separately inside the
+    whole-round kernel); the full prefix still matches ``lookup_step``
+    bit-for-bit because the fused kernel is bit-identical to the
+    composition by contract.
     """
     from functools import partial as _partial
 
@@ -553,15 +572,15 @@ def _round_prefix_fn(upto: str):
         fr_dist = jnp.where(evict, jnp.uint32(sw.UINT32_MAX), st.dist)
         impl = sw.resolve_merge_impl(cfg)
         done_merge = None
-        if impl == "pallas":
+        if impl in ("pallas", "pallas-round"):
             from ..ops.pallas_kernels import merge_round_pallas
             f_idx, f_dist, f_q, done_merge = merge_round_pallas(
                 idx2, fr_dist, queried, resp, resp_d0,
                 quorum=cfg.quorum, keep=cfg.search_width)
         elif impl == "xla":
-            f_idx, f_dist, f_q = sw.rank_merge_round_d0(
+            f_idx, f_dist, f_q = sw.rank_merge_round_d0_w(
                 idx2, fr_dist, queried, resp, resp_d0,
-                keep=cfg.search_width)
+                keep=cfg.search_width, merge_w=merge_w)
         else:
             cand_idx = jnp.concatenate([idx2, resp], axis=1)
             cand_dist = jnp.concatenate([fr_dist, resp_d0], axis=1)
@@ -586,7 +605,9 @@ def _round_prefix_fn(upto: str):
 
 
 def measure_round_phases(swarm, cfg, targets, key,
-                         repeats: int = 3) -> dict:
+                         repeats: int = 3,
+                         merge_w: int | None = None,
+                         advance_rounds: int = 0) -> dict:
     """One-shot instrumented A/B pass: time each round sub-phase in
     isolation against the fused round and return the attribution table.
 
@@ -602,6 +623,13 @@ def measure_round_phases(swarm, cfg, targets, key,
     Runs at the full batch width of ``targets`` on a first-round state
     (``lookup_init``'s output): the widest, costliest round shape — the
     one the p50 of a mostly-full-width burst schedule reflects.
+
+    ``merge_w`` prices the merge phase at a round-18 width-ladder rung
+    (guarded, bit-identical — the prefix-equivalence assertion covers
+    the laddered planes too); ``advance_rounds`` first advances the
+    state that many plain rounds so the live-slot watermark reflects a
+    TAIL round rather than the everything-unqueried first round — the
+    shape the rung is actually dispatched at.
     """
     from ..models import swarm as sw
 
@@ -613,13 +641,15 @@ def measure_round_phases(swarm, cfg, targets, key,
     upto_of = {"scatter-writeback": "full"}
     origins = sw._sample_origins(key, swarm.alive, targets.shape[0])
     st = sw.lookup_init(swarm, cfg, targets, origins)
+    for _ in range(max(0, advance_rounds)):
+        st = sw.lookup_step(swarm, cfg, st)
     jax.block_until_ready(st)
 
     walls, costs = [], []
     full_out = None
     for name in phase_names:
         upto = upto_of.get(name, name)
-        fn = _round_prefix_fn(upto)
+        fn = _round_prefix_fn(upto, merge_w=merge_w)
         compiled = fn.lower(swarm, cfg, st).compile()
         try:
             flops_bytes = _parse_cost(compiled.cost_analysis())
@@ -644,13 +674,14 @@ def measure_round_phases(swarm, cfg, targets, key,
     # so its wall is an independent fused-round measurement — recorded
     # as the cross-check target for artifacts that carry no bench
     # round_wall_p50 (the sharded mode's ledger).
-    ref = sw.lookup_step(swarm, cfg, st)
+    ref = sw.lookup_step(swarm, cfg, st, merge_w=merge_w)
     jax.block_until_ready(ref)
     step_best = float("inf")
     for _ in range(max(1, repeats)):
         t0 = time.perf_counter()
         # graftlint: disable=sync-in-loop (dedicated timing pass: the barrier IS the measurement, never on a serving path)
-        jax.block_until_ready(sw.lookup_step(swarm, cfg, st))
+        jax.block_until_ready(sw.lookup_step(swarm, cfg, st,
+                                             merge_w=merge_w))
         step_best = min(step_best, time.perf_counter() - t0)
     for name, a, b in zip(sw.LookupState._fields, full_out, ref):
         if not np.array_equal(np.asarray(a), np.asarray(b)):
@@ -679,7 +710,7 @@ def measure_round_phases(swarm, cfg, targets, key,
             row["flops"] = row["bytes_accessed"] = None
         rows.append(row)
         prev_w, prev_c = w, c
-    return {
+    out = {
         "width": int(targets.shape[0]),
         "repeats": int(repeats),
         "rows": rows,
@@ -687,3 +718,8 @@ def measure_round_phases(swarm, cfg, targets, key,
         "lookup_step_wall_s": round(step_best, 6),
         "prefix_equivalent": True,
     }
+    if merge_w is not None:
+        out["merge_w"] = int(merge_w)
+    if advance_rounds:
+        out["advance_rounds"] = int(advance_rounds)
+    return out

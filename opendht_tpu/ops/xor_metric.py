@@ -454,13 +454,30 @@ def rank_merge_round_d0(fr_idx: jax.Array, fr_d0: jax.Array,
     idx_u among the all-ones group, bit-identically to the sorted
     reference.
 
+    NARROWED PLANES (round 18): the counting planes accumulate in the
+    narrowest unsigned dtype that provably fits every rank — u8 while
+    ``S + C ≤ 255`` (the engine's S≤14, C = α·2K domain with wide
+    margin), u16 to 65535, i32 beyond; every cross-run plane reduces
+    over its MINOR axis (the ``pos_b`` count is computed transposed as
+    ``#(A ≤ KB_j)`` directly instead of ``S − #(B < A)``); and
+    placement is a one-hot min/max CONTRACTION over the ``out_w``-wide
+    head instead of the former two row scatters — measured 2.3× on
+    XLA:CPU at the gate geometry (the scatters alone were ~48 % of the
+    merge wall; see BASELINE.md round 18).  Overflow safety of the
+    narrow accumulators is by construction (every count is bounded by
+    S + C) and pinned at the dtype boundaries in
+    ``tests/test_merge_equivalence.py``.
+
     Returns ``(idx, d0, queried)``, each ``[L, min(keep, S+C)]``.
     """
     l, s = fr_idx.shape
     c = resp_idx.shape[1]
     out_w = min(keep, s + c)
     maxu = jnp.uint32(0xFFFFFFFF)
-    rows = jnp.arange(l, dtype=jnp.int32)[:, None]
+    # Narrow rank accumulators: ranks/positions are bounded by S+C.
+    w = s + c
+    acc = jnp.uint8 if w <= 255 else (
+        jnp.uint16 if w <= 65535 else jnp.int32)
 
     # --- run A: the frontier in place.  Valid entries are sorted and
     # duplicate-free by contract, so their within-run rank is the
@@ -469,7 +486,7 @@ def rank_merge_round_d0(fr_idx: jax.Array, fr_d0: jax.Array,
     fv = fr_idx >= 0
     a_idxu = fr_idx.astype(jnp.uint32)
     a_d0 = jnp.where(fv, fr_d0, maxu)
-    rank_a = jnp.cumsum(fv.astype(jnp.int32), axis=1) - 1
+    rank_a = jnp.cumsum(fv.astype(acc), axis=1) - acc(1)
 
     # --- run B: responses.  Dedup by membership plane (vs the valid
     # frontier) and by earlier-slot equality (vs other responses).
@@ -493,30 +510,137 @@ def rank_merge_round_d0(fr_idx: jax.Array, fr_d0: jax.Array,
     ltb = (bk_d0 < bj_d0) | ((bk_d0 == bj_d0)
                              & ((bk_ix < bj_ix)
                                 | ((bk_ix == bj_ix) & earlier)))
-    rank_b = jnp.sum(ltb.astype(jnp.int32), axis=2)
+    rank_b = jnp.sum(ltb.astype(acc), axis=2)
 
-    # --- cross-run ranks from ONE [L,S,C] plane: lt[i,j] = KB_j < KA_i
-    # (strict).  Frontier entry i gains the strict count (equal B keys
-    # place AFTER it); response j gains S − count = #(A ≤ KB_j) (equal
-    # A keys place BEFORE it) — the frontier-first input-ordinal rule.
-    lt = (b_d0[:, None, :] < a_d0[:, :, None]) | (
+    # --- cross-run ranks from two planes, EACH reduced over its minor
+    # axis.  Frontier entry i gains the strict count #(KB_j < KA_i)
+    # (equal B keys place AFTER it) from a [L,S,C] plane; response j
+    # gains #(A ≤ KB_j) (equal A keys place BEFORE it — the
+    # frontier-first input-ordinal rule) from the TRANSPOSED [L,C,S]
+    # plane, so neither reduction strides and neither plane needs
+    # materializing for a second reduction direction.
+    lt_a = (b_d0[:, None, :] < a_d0[:, :, None]) | (
         (b_d0[:, None, :] == a_d0[:, :, None])
         & (r_idxu[:, None, :] < a_idxu[:, :, None]))
-    lt_i = lt.astype(jnp.int32)
-    pos_a = jnp.where(fv, rank_a + jnp.sum(lt_i, axis=2), out_w)
-    pos_b = jnp.where(dup, out_w,
-                      rank_b + s - jnp.sum(lt_i, axis=1))
+    pos_a = jnp.where(fv, rank_a + jnp.sum(lt_a.astype(acc), axis=2),
+                      acc(out_w))
+    ge_b = ~((b_d0[:, :, None] < a_d0[:, None, :]) | (
+        (b_d0[:, :, None] == a_d0[:, None, :])
+        & (r_idxu[:, :, None] < a_idxu[:, None, :])))
+    pos_b = jnp.where(dup, acc(out_w),
+                      rank_b + jnp.sum(ge_b.astype(acc), axis=2))
 
-    # --- placement: one scatter per run; everything not scattered
-    # (duplicates, empties, ranks past the kept width) reads the fill.
-    o_idx = jnp.full((l, out_w), -1, jnp.int32)
-    o_d0 = jnp.full((l, out_w), maxu)
-    o_q = jnp.zeros((l, out_w), bool)
-    o_idx = o_idx.at[rows, pos_a].set(fr_idx, mode="drop"
-                                      ).at[rows, pos_b].set(
-        resp_idx, mode="drop")
-    o_d0 = o_d0.at[rows, pos_a].set(a_d0, mode="drop"
-                                    ).at[rows, pos_b].set(
-        b_d0, mode="drop")
-    o_q = o_q.at[rows, pos_a].set(fr_q, mode="drop")
+    # --- placement: one-hot min/max contraction over the kept head.
+    # Positions are unique among survivors (a total order), so each
+    # output slot matches at most one entry per run; duplicates,
+    # empties and ranks past the kept width hold the fill.  Replaces
+    # the former two `.at[rows, pos].set` scatters, which ran on the
+    # scalar scatter path and dominated the merge wall on CPU.
+    iota_k = jnp.arange(out_w, dtype=acc)[None, None, :]
+    ha = pos_a[:, :, None] == iota_k                     # [L,S,out_w]
+    hb = pos_b[:, :, None] == iota_k                     # [L,C,out_w]
+    o_idx = jnp.maximum(
+        jnp.max(jnp.where(ha, fr_idx[:, :, None], -1), axis=1),
+        jnp.max(jnp.where(hb, resp_idx[:, :, None], -1), axis=1))
+    o_d0 = jnp.minimum(
+        jnp.min(jnp.where(ha, a_d0[:, :, None], maxu), axis=1),
+        jnp.min(jnp.where(hb, b_d0[:, :, None], maxu), axis=1))
+    o_q = jnp.any(ha & fr_q[:, :, None], axis=1)
     return o_idx, o_d0, o_q
+
+
+def merge_ladder_widths(c: int, block: int) -> list[int]:
+    """Ascending power-of-two response-width ladder for a ``[*, c]``
+    response plane whose live slots arrive in ``block``-wide runs (one
+    solicited node's 2K candidates).
+
+    Rungs are ``block · 2^j`` capped at (and always including) ``c`` —
+    the candidate-width twin of the row ladder's ``L → 2^k`` prefix
+    shapes: at most ``log2(c/block) + 1`` step specializations, widths
+    chosen per burst from the live-slot watermark the done-check
+    readback already pays for."""
+    if c <= 0 or block <= 0:
+        return [max(c, 0)]
+    widths = set()
+    w = min(block, c)
+    while w < c:
+        widths.add(w)
+        w *= 2
+    widths.add(c)
+    return sorted(widths)
+
+
+def pick_merge_width(wneed: int, c: int, block: int) -> int | None:
+    """Smallest ladder rung covering ``wneed`` live response columns.
+
+    Returns ``None`` for the full width so callers keep dispatching the
+    exact pre-ladder program (byte-identical jit cache key) when the
+    ladder cannot help."""
+    for w in merge_ladder_widths(c, block):
+        if w >= wneed:
+            return None if w >= c else w
+    return None
+
+
+def rank_merge_round_d0_w(fr_idx: jax.Array, fr_d0: jax.Array,
+                          fr_q: jax.Array, resp_idx: jax.Array,
+                          resp_d0: jax.Array, keep: int,
+                          merge_w: int | None
+                          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Width-laddered :func:`rank_merge_round_d0`: rank planes priced
+    at ``merge_w ≤ C`` response columns, GUARDED in-jit so any width
+    choice is sound.
+
+    The response block arrives at full width ``C = α·2K`` every round,
+    but its live columns are bounded by the round's live-slot watermark
+    (``2K ×`` the widest row's live solicitation count) — in tail
+    rounds most of the block is empty and the O(C²) rank planes price
+    dead columns.  The caller (the burst loops) picks ``merge_w`` from
+    the watermark the previous done-check readback returned; because
+    the watermark is NOT monotone (a merged round can add unqueried
+    candidates), the choice is protected by an in-jit guard: columns
+    ``≥ merge_w`` are checked live-free, and ``lax.cond`` falls back
+    to the full-width planes when the guard fails — bit-identical
+    output either way, the narrow path merely cheaper.  (An in-jit
+    ``switch`` picking the width per ROUND was measured 2.5× slower at
+    full width on XLA:CPU: ops inside a data-dependent conditional
+    lose the parallel task assignment, so the full-width rung must
+    stay OUTSIDE any conditional — the guard only wraps dispatches the
+    caller already narrowed.)
+
+    Dropping all-invalid trailing columns is exact: an invalid entry's
+    key is (all-ones d0, all-ones idx_u), which never precedes any
+    other entry under the total order and never emits a payload, so
+    removing it changes no rank and no output (the documented
+    live-sentinel corner keeps its REAL idx_u and is untouched —
+    sliced columns are invalid everywhere, not sentinel-valued).
+    """
+    l, s = fr_idx.shape
+    c = resp_idx.shape[1]
+    if merge_w is None or merge_w >= c:
+        return rank_merge_round_d0(fr_idx, fr_d0, fr_q, resp_idx,
+                                   resp_d0, keep)
+    out_w = min(keep, s + c)
+
+    def pad_out(out):
+        o_idx, o_d0, o_q = out
+        padw = out_w - o_idx.shape[1]
+        if padw <= 0:
+            return out
+        return (jnp.concatenate(
+            [o_idx, jnp.full((l, padw), -1, jnp.int32)], axis=1),
+            jnp.concatenate(
+                [o_d0, jnp.full((l, padw), jnp.uint32(0xFFFFFFFF))],
+                axis=1),
+            jnp.concatenate([o_q, jnp.zeros((l, padw), bool)], axis=1))
+
+    def narrow(fi, fd, fq, ri, rd):
+        return pad_out(rank_merge_round_d0(
+            fi, fd, fq, ri[:, :merge_w], rd[:, :merge_w], keep))
+
+    def full(fi, fd, fq, ri, rd):
+        return rank_merge_round_d0(fi, fd, fq, ri, rd, keep)
+
+    overflow = jnp.any(resp_idx[:, merge_w:] >= 0)
+    return jax.lax.cond(overflow, full, narrow, fr_idx, fr_d0, fr_q,
+                        resp_idx, resp_d0)

@@ -42,6 +42,7 @@ from ..models.swarm import (
     _gather_span,
     _ladder_width,
     _local_respond,
+    _pending_and_wneed,
     _permute_state,
     _respond,
     _sample_origins,
@@ -59,11 +60,16 @@ from ..models.swarm import (
     init_impl,
     init_lifecycle,
     lookup,
+    resolve_merge_impl,
     run_burst_loop,
     step_impl,
     table_bytes,
 )
-from ..ops.xor_metric import prefix_len32
+from ..ops.xor_metric import (
+    merge_ladder_widths,
+    pick_merge_width,
+    prefix_len32,
+)
 from ..utils.hostdevice import dev_i32
 from .mesh import AXIS, shard_map
 
@@ -164,7 +170,7 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
     their first-limb XOR distance to the target (from the shortlist
     state — no id gather).  Returns ``(resp [Ll, A*2K], resp_d0
     [Ll, A*2K], answered [Ll, A])``.  Queries ship ``(local_row,
-    bucket, bucket+1)`` to the owner shard in fixed-capacity buckets
+    bucket)`` to the owner shard in fixed-capacity buckets
     of ``C = capacity_factor · Q/D`` (expected load per shard times
     head-room — NOT the worst-case Q, which would inflate shuffle
     traffic D×), are answered by local gathers of the index + member-
@@ -206,20 +212,26 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
     local_row = safe - owner * shard_n
     local_row = jnp.where(ok, local_row, -1)
 
-    # One stacked [D, C, 3] shuffle instead of three collectives: the
+    # One stacked [D, C, 2] shuffle instead of three collectives: the
     # per-collective launch latency sits on the lock-step critical
     # path.  Buckets fill by sort + row gather (see ``_bucketize``).
+    # RIGHT-SIZED (round 18): only ``(local_row, c0)`` ship — the
+    # second bucket index is always the adjacent one, so the owner
+    # derives ``c1 = min(c0+1, B-1)`` locally instead of paying a
+    # third shuffle column for a value one add reproduces (1/3 of the
+    # query-leg bytes and of the ``_fill_buckets`` gather width, the
+    # +51.9 % routed-overhead satellite's first finding).
     src, pos, sent = _bucketize(owner, ok, n_shards, cap)
-    pay = jnp.stack([local_row, c0, c1], axis=-1)          # [Q,3]
+    pay = jnp.stack([local_row, c0], axis=-1)              # [Q,2]
     qbuf = _fill_buckets(pay, src, n_shards, cap, -1)
 
     a2a = partial(jax.lax.all_to_all, axis_name=AXIS, split_axis=0,
                   concat_axis=0, tiled=True)
     rbuf = a2a(qbuf)
     slot = owner * cap + jnp.clip(pos, 0, cap - 1)         # [Q]
-    r_row, r_c0, r_c1 = rbuf[..., 0], rbuf[..., 1], rbuf[..., 2]
+    r_row, r_c0 = rbuf[..., 0], rbuf[..., 1]
     r_c0 = jnp.clip(r_c0, 0, cfg.n_buckets - 1)
-    r_c1 = jnp.clip(r_c1, 0, cfg.n_buckets - 1)
+    r_c1 = jnp.clip(r_c0 + 1, 0, cfg.n_buckets - 1)
 
     # Owner-side fetch of the two bucket rows.  Augmented tables: one
     # whole-row gather per query (the only fast gather over a big
@@ -365,7 +377,8 @@ def _sharded_lookup_while(swarm: Swarm, cfg: SwarmConfig,
 
 
 def _make_respond_body(cfg, n_shards, capacity_factor, local_respond,
-                       init, cap_nq=None, with_rnd=False):
+                       init, cap_nq=None, with_rnd=False,
+                       merge_w=None):
     """Single-round shard_map bodies for the burst path (same respond
     contract as the while formulation via ``_make_responders``).
     ``cap_nq`` pins capacity provisioning to the full batch width for
@@ -373,7 +386,9 @@ def _make_respond_body(cfg, n_shards, capacity_factor, local_respond,
     ``with_rnd`` adds the round index as a replicated argument — only
     lifecycle-tracked states need it (``_merge_round``'s
     ``completed_round`` stamp), so untracked programs stay
-    byte-identical."""
+    byte-identical.  ``merge_w`` is the static merge-width rung
+    (guarded in-jit — see ``rank_merge_round_d0_w``); ``None`` keeps
+    the exact pre-ladder program."""
     def init_body(ids, tables_local, alive, targets, key):
         ll = targets.shape[0]
         me = jax.lax.axis_index(AXIS)
@@ -388,13 +403,15 @@ def _make_respond_body(cfg, n_shards, capacity_factor, local_respond,
         _, respond = _make_responders(
             cfg, n_shards, capacity_factor, local_respond, ids,
             tables_local, alive, cap_nq=cap_nq)
-        return step_impl(ids, alive, respond, cfg, st)
+        return step_impl(ids, alive, respond, cfg, st,
+                         merge_w=merge_w)
 
     def step_body_rnd(ids, tables_local, alive, st, rnd):
         _, respond = _make_responders(
             cfg, n_shards, capacity_factor, local_respond, ids,
             tables_local, alive, cap_nq=cap_nq)
-        return step_impl(ids, alive, respond, cfg, st, rnd=rnd)
+        return step_impl(ids, alive, respond, cfg, st, rnd=rnd,
+                         merge_w=merge_w)
 
     if init:
         return init_body
@@ -428,16 +445,18 @@ def _sharded_lookup_init(swarm, cfg, targets, key, mesh,
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor",
-                                   "local_respond", "cap_nq"),
+                                   "local_respond", "cap_nq",
+                                   "merge_w"),
          donate_argnums=(2,))
 def _sharded_lookup_step(swarm, cfg, st, mesh, capacity_factor,
-                         local_respond=False, cap_nq=None, rnd=None):
+                         local_respond=False, cap_nq=None, rnd=None,
+                         merge_w=None):
     n_shards = mesh.shape[AXIS]
     track = st.admitted_round is not None
     with_rnd = rnd is not None
     body = _make_respond_body(cfg, n_shards, capacity_factor,
                               local_respond, init=False, cap_nq=cap_nq,
-                              with_rnd=with_rnd)
+                              with_rnd=with_rnd, merge_w=merge_w)
     in_specs = (P(), P(AXIS, None), P(), _st_specs(track))
     args = (swarm.ids, swarm.tables, swarm.alive, st)
     if with_rnd:
@@ -450,6 +469,16 @@ def _sharded_lookup_step(swarm, cfg, st, mesh, capacity_factor,
 
 def _table_bytes_per_device(cfg: SwarmConfig, n_shards: int) -> int:
     return table_bytes(cfg) // max(1, n_shards)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_shards"))
+def _shard_pending_and_wneed(st, cfg: SwarmConfig, n_shards: int):
+    """Fused per-burst readback pair for the sharded ladder: per-shard
+    pending counts (the row ladder's worst-shard width driver) and the
+    mesh-global live-slot watermark — ONE device program, ONE
+    device_get, like the local loop's ``_pending_and_wneed``."""
+    per_shard = jnp.sum(~st.done.reshape(n_shards, -1), axis=1)
+    return per_shard, _pending_and_wneed(st, cfg)[1]
 
 
 # ---------------------------------------------------------------------------
@@ -718,23 +747,42 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
     burst = max(2, burst_schedule(cfg) - 2)
     rounds = row_rounds = 0
     widths = []
+    # Merge-width ladder (round 18): the same per-burst live-slot
+    # watermark rung as the local loop, mesh-global (the rank planes
+    # run per shard inside shard_map, so the rung must cover the WORST
+    # shard's watermark — the max rides the same readback as pend).
+    # Guarded in-jit, so a stale rung is bit-identical, just full
+    # price.  XLA rank-merge path only; the while formulation and the
+    # Pallas kernels keep their fixed-width programs.
+    resp_w = cfg.alpha * 2 * cfg.bucket_k
+    width_ladder = (resolve_merge_impl(cfg) == "xla"
+                    and len(merge_ladder_widths(
+                        resp_w, 2 * cfg.bucket_k)) > 1)
+    merge_w = None
+    merge_widths = []
     while rounds < cfg.max_steps:
         n = min(burst, cfg.max_steps - rounds)
         for _ in range(n):
             sub = _sharded_lookup_step(swarm, cfg, sub, mesh,
                                        capacity_factor, local_respond,
-                                       cap_nq, rnd=rnd_of(rounds))
+                                       cap_nq, rnd=rnd_of(rounds),
+                                       merge_w=merge_w)
             rounds += 1
             row_rounds += w * n_shards
         if w not in widths:
             widths.append(w)
+        if merge_w not in merge_widths:
+            merge_widths.append(merge_w)
         # graftlint: disable=sync-in-loop (per-BURST done-check readback, amortized over >=2 device rounds — the ladder exists to pay this once per burst, not per round)
-        pend = jax.device_get(
-            jnp.sum(~sub.done.reshape(n_shards, w), axis=1))
+        pend, wneed = jax.device_get(
+            _shard_pending_and_wneed(sub, cfg, n_shards))
         total = int(pend.sum())
         if total == 0:
             break
         burst = 2
+        if width_ladder:
+            merge_w = pick_merge_width(int(wneed), resp_w,
+                                       2 * cfg.bucket_k)
         if rebalance:
             w_new = _ladder_width(-(-total // n_shards), ll)
             if w_new < w:
@@ -769,6 +817,9 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
         stats["mean_active_frac"] = (
             round(row_rounds / (rounds * l), 4) if rounds else 0.0)
         stats["widths"] = widths
+        if width_ladder:
+            stats["merge_widths"] = [resp_w if mw is None else mw
+                                     for mw in merge_widths]
     found = _scatter_rows(_finalize(swarm.ids, full, cfg), order)
     return LookupResult(found=found,
                         hops=_scatter_rows(full.hops, order),

@@ -277,6 +277,25 @@ def check_ledger_obj(obj: dict) -> List[str]:
         if not rp.get("prefix_equivalent"):
             errs.append("round_phases: prefix decomposition not "
                         "asserted equivalent to the fused round")
+    rpl = obj.get("round_phases_laddered")
+    if rpl is not None:
+        # Round-18 width-laddered attribution: self-consistent against
+        # its OWN fused-round measurement (the laddered table runs at
+        # a tail-round state, so the bench's full-width round_wall_p50
+        # is not its target), prefix-equivalence mandatory like the
+        # primary table, and the rung it priced must be recorded.
+        _check_phase_rows(rpl.get("rows"),
+                          rpl.get("fused_round_wall_s"),
+                          "round_phases_laddered",
+                          "fused_round_wall_s", errs,
+                          allow_negative_frac=0.05)
+        if not rpl.get("prefix_equivalent"):
+            errs.append("round_phases_laddered: prefix decomposition "
+                        "not asserted equivalent to the fused round")
+        if not (_num(rpl.get("merge_w")) and rpl["merge_w"] > 0):
+            errs.append(f"round_phases_laddered: merge_w "
+                        f"{rpl.get('merge_w')!r} missing or invalid — "
+                        f"a laddered table must record its rung")
     repub = obj.get("repub_profile")
     if repub is not None:
         _check_phase_rows(repub.get("rows"), repub.get("sweep_wall_s"),

@@ -99,6 +99,17 @@ def roofline_report(ledger: dict, peak_gflops: Optional[float] = None,
                 row.get("wall_s"), row.get("flops"),
                 row.get("bytes_accessed"), peak_gflops, peak_gbps)})
 
+    # Round-18 width-laddered attribution (tail-round state, merge
+    # priced at a ladder rung): classified like the primary table so
+    # the narrowed planes get their own verdict row.
+    phases_laddered = []
+    rpl = ledger.get("round_phases_laddered")
+    if rpl:
+        for row in rpl.get("rows", []):
+            phases_laddered.append({"phase": row["phase"], **classify(
+                row.get("wall_s"), row.get("flops"),
+                row.get("bytes_accessed"), peak_gflops, peak_gbps)})
+
     kernels = []
     for k in ledger.get("kernels", []):
         kernels.append({
@@ -124,6 +135,8 @@ def roofline_report(ledger: dict, peak_gflops: Optional[float] = None,
                         peak_gflops / peak_gbps, 3),
                     "spec_source": spec_source},
         "round_phases": phases,
+        "round_phases_laddered": phases_laddered,
+        "laddered_merge_w": (rpl or {}).get("merge_w"),
         "kernels": kernels,
         "repub_profile": repub,
         "errors": errs,
@@ -158,6 +171,11 @@ def render_markdown(report: dict) -> str:
     if report["round_phases"]:
         lines += ["### Round sub-phases", ""]
         lines += _md_table(report["round_phases"], "phase") + [""]
+    if report.get("round_phases_laddered"):
+        lines += [f"### Round sub-phases — width-laddered merge "
+                  f"(rung {report.get('laddered_merge_w')})", ""]
+        lines += _md_table(report["round_phases_laddered"],
+                           "phase") + [""]
     if report["repub_profile"]:
         lines += ["### Republish sweep phases", ""]
         lines += _md_table(report["repub_profile"], "phase") + [""]

@@ -36,8 +36,11 @@ from opendht_tpu.models.swarm import (
 )
 from opendht_tpu.ops.pallas_kernels import merge_round_pallas
 from opendht_tpu.ops.xor_metric import (
+    merge_ladder_widths,
     merge_shortlists_d0,
+    pick_merge_width,
     rank_merge_round_d0,
+    rank_merge_round_d0_w,
 )
 
 L, S, C, NN = 64, 14, 32, 500
@@ -169,6 +172,138 @@ class TestRankMergeEquivalence:
         assert_bit_equal(a, (oi, od, oq), "pallas keep>width")
 
 
+class TestWidthLadder:
+    """Round-18 merge-width ladder: the guarded laddered merge must be
+    bit-equal to the full-width planes (and hence the sorted
+    reference) for EVERY rung, whether the rung covers the live
+    watermark (narrow branch) or not (overflow guard's full-width
+    fallback)."""
+
+    def test_ladder_width_lists(self):
+        assert merge_ladder_widths(64, 16) == [16, 32, 64]
+        assert merge_ladder_widths(48, 16) == [16, 32, 48]
+        assert merge_ladder_widths(16, 16) == [16]
+        assert pick_merge_width(0, 64, 16) == 16
+        assert pick_merge_width(16, 64, 16) == 16
+        assert pick_merge_width(17, 64, 16) == 32
+        # Full width returns None — callers keep the exact pre-ladder
+        # program (same jit cache key).
+        assert pick_merge_width(33, 64, 16) is None
+        assert pick_merge_width(64, 64, 16) is None
+
+    @pytest.mark.parametrize("merge_w", [8, 16, 32, None])
+    @pytest.mark.parametrize("live_w", [12, 32, C])
+    def test_guarded_rungs_bit_equal(self, merge_w, live_w):
+        """Every (rung, watermark) pairing — covered and overflowing —
+        reproduces the reference bit-for-bit."""
+        fi, fd, fq = make_frontier(11)
+        ri, rd = adversarial_responses(1011, fi)
+        # Confine live responses to the first live_w columns.
+        kill = jnp.arange(C)[None, :] >= live_w
+        ri = jnp.where(kill, -1, ri)
+        rd = jnp.where(kill, MAXU, rd)
+        a = ref_merge(fi, fd, fq, ri, rd, S)
+        b = rank_merge_round_d0_w(fi, fd, fq, ri, rd, S,
+                                  merge_w=merge_w)
+        assert_bit_equal(a, b, f"ladder rung={merge_w} live={live_w}")
+
+    def test_keep_wider_than_narrow_rung(self):
+        """keep > S + rung: the narrow branch's output pads back to the
+        full ``min(keep, S+C)`` width with fill, bit-equal to the
+        reference."""
+        fi, fd, fq = make_frontier(12)
+        ri, rd = adversarial_responses(1012, fi)
+        kill = jnp.arange(C)[None, :] >= 8
+        ri = jnp.where(kill, -1, ri)
+        rd = jnp.where(kill, MAXU, rd)
+        keep = S + C + 3
+        a = ref_merge(fi, fd, fq, ri, rd, keep)
+        b = rank_merge_round_d0_w(fi, fd, fq, ri, rd, keep, merge_w=8)
+        assert_bit_equal(a, b, "ladder keep>width")
+
+    def test_sentinel_live_in_narrow_rung(self):
+        """The documented live-0xFFFFFFFF-d0 corner inside a narrow
+        rung: the candidate ranks among the all-ones group by its real
+        index, bit-identically, with the rest of the block invalid."""
+        fi = jnp.full((L, S), -1, jnp.int32)
+        fd = jnp.full((L, S), MAXU)
+        fq = jnp.zeros((L, S), bool)
+        ri = jnp.full((L, C), -1, jnp.int32
+                      ).at[:, 1].set(9).at[:, 3].set(5)
+        rd = jnp.full((L, C), MAXU)
+        a = ref_merge(fi, fd, fq, ri, rd, S)
+        b = rank_merge_round_d0_w(fi, fd, fq, ri, rd, S, merge_w=8)
+        assert_bit_equal(a, b, "ladder live-sentinel")
+        assert int(b[0][0, 0]) == 5 and int(b[0][0, 1]) == 9
+
+
+class TestDtypeEdges:
+    """Round-18 narrowed accumulators: the u8 (width ≤ 255) and u16
+    (≤ 65535) rank planes must reproduce the u32-era reference at the
+    dtype boundaries — positions saturating the accumulator range,
+    0xFFFF/0xFFFFFFFF-valued d0 keys, dup ids with different window
+    d0s, all-invalid rows, keep past the candidate width."""
+
+    def _wide_inputs(self, seed, c_wide):
+        r = np.random.default_rng(seed)
+        cd0 = jnp.asarray(r.integers(0, 2**32, (8, S + c_wide),
+                                     dtype=np.uint32))
+        ci = jnp.asarray(r.integers(-1, 10**6, (8, S + c_wide),
+                                    dtype=np.int32))
+        cq = jnp.asarray(r.random((8, S + c_wide)) < 0.5) & (ci >= 0)
+        fi, fd, fq = merge_shortlists_d0(cd0, ci, cq, keep=S)
+        ri = r.integers(-1, 10**6, (8, c_wide), dtype=np.int32)
+        rd = r.integers(0, 2**32, (8, c_wide), dtype=np.uint32)
+        rd[np.asarray(ri) < 0] = MAXU
+        # Seed the documented corners: frontier dups at different d0s,
+        # within-block dups, sentinel-d0 live rows, 0xFFFF-low keys.
+        ri[:, 0] = np.asarray(fi)[:, 0]
+        ri[:, 1] = ri[:, 2]
+        rd[:, 3] = MAXU
+        rd[:, 4] = np.uint32(0xFFFF)
+        rd[:, 5] = np.uint32(0xFFFF0000)
+        return fi, fd, fq, jnp.asarray(ri), jnp.asarray(rd)
+
+    @pytest.mark.parametrize("c_wide", [241, 242, 260, 300])
+    def test_u8_u16_boundary_widths(self, c_wide):
+        """S + C crossing 255 flips the accumulator u8 → u16; both
+        sides must be bit-equal to the sorted reference, with ranks
+        driven to the top of the output (keep = full width, all rows
+        mostly live so positions reach S+C-1)."""
+        fi, fd, fq, ri, rd = self._wide_inputs(100 + c_wide, c_wide)
+        keep = S + c_wide
+        a = ref_merge(fi, fd, fq, ri, rd, keep)
+        b = rank_merge_round_d0(fi, fd, fq, ri, rd, keep)
+        assert_bit_equal(a, b, f"dtype boundary C={c_wide}")
+        # The tail of the output must really be exercised (positions
+        # near the accumulator edge), or the boundary test is vacuous.
+        assert int(jnp.sum(a[0][:, -16:] >= 0)) > 0
+
+    def test_all_invalid_wide(self):
+        fi = jnp.full((4, S), -1, jnp.int32)
+        fd = jnp.full((4, S), MAXU)
+        fq = jnp.zeros((4, S), bool)
+        ri = jnp.full((4, 250), -1, jnp.int32)
+        rd = jnp.full((4, 250), MAXU)
+        a = ref_merge(fi, fd, fq, ri, rd, S)
+        b = rank_merge_round_d0(fi, fd, fq, ri, rd, S)
+        assert_bit_equal(a, b, "wide all-invalid")
+        assert bool(jnp.all(b[0] == -1))
+
+    def test_sentinel_collision_0xffff(self):
+        """Live candidates whose d0 carries 0xFFFF halves (the u16
+        window-surrogate extremes) must neither collide with the
+        all-ones empty sentinel nor misrank at u8 positions."""
+        fi, fd, fq = make_frontier(13)
+        ri, rd = adversarial_responses(1013, fi)
+        rd = rd.at[:, ::4].set(jnp.uint32(0x0000FFFF))
+        rd = rd.at[:, 1::4].set(jnp.uint32(0xFFFF0000))
+        rd = rd.at[:, 2::4].set(MAXU)
+        a = ref_merge(fi, fd, fq, ri, rd, S)
+        b = rank_merge_round_d0(fi, fd, fq, ri, rd, S)
+        assert_bit_equal(a, b, "0xFFFF sentinel edges")
+
+
 CFG_AUTO = SwarmConfig.for_nodes(2048)
 CFG_SORT = CFG_AUTO._replace(merge_impl="xla-sort")
 
@@ -236,6 +371,48 @@ class TestEngineEquivalence:
         r_p = lookup(sw, cfg_p, tg, jax.random.PRNGKey(2))
         r_s = lookup(sw, cfg_s, tg, jax.random.PRNGKey(2))
         assert res_equal(r_p, r_s)
+
+    def test_fused_round_step_bit_identical(self):
+        """The whole-round fused kernel (merge_impl="pallas-round")
+        threaded through lookup_step must reproduce the composed round
+        (alpha-select + gather + window decode + queried/evict + merge
+        + done) bit-for-bit on a CHURNED swarm — dead-node eviction and
+        invalid solicitations included.  Interpret mode; tiny swarm."""
+        from opendht_tpu.models.swarm import (_sample_origins, churn,
+                                              lookup_init, lookup_step)
+        cfg_p = SwarmConfig.for_nodes(512, merge_impl="pallas-round")
+        cfg_s = cfg_p._replace(merge_impl="xla-sort")
+        sw = build_swarm(jax.random.PRNGKey(0), cfg_p)
+        sw = churn(sw, jax.random.PRNGKey(9), 0.2, cfg_p)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (32, 5), jnp.uint32)
+        origins = _sample_origins(jax.random.PRNGKey(2), sw.alive, 32)
+        st = lookup_init(sw, cfg_p, tg, origins)
+        for _ in range(3):             # several rounds deep, not just 1
+            s_p = lookup_step(sw, cfg_p, st)
+            s_s = lookup_step(sw, cfg_s, st)
+            for name, a, b in zip(st._fields, s_p, s_s):
+                if a is None:
+                    continue
+                assert np.array_equal(np.asarray(a), np.asarray(b)), \
+                    f"fused round diverged on {name}"
+            st = s_s
+
+    def test_fused_round_engine_bit_identical(self):
+        cfg_p = SwarmConfig.for_nodes(512, merge_impl="pallas-round")
+        cfg_s = cfg_p._replace(merge_impl="xla-sort")
+        sw = build_swarm(jax.random.PRNGKey(0), cfg_p)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (32, 5), jnp.uint32)
+        r_p = lookup(sw, cfg_p, tg, jax.random.PRNGKey(2))
+        r_s = lookup(sw, cfg_s, tg, jax.random.PRNGKey(2))
+        assert res_equal(r_p, r_s)
+
+    def test_fused_round_requires_aug_tables(self):
+        cfg_p = SwarmConfig.for_nodes(512, merge_impl="pallas-round",
+                                      aug_tables=False)
+        sw = build_swarm(jax.random.PRNGKey(0), cfg_p)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (32, 5), jnp.uint32)
+        with pytest.raises(ValueError, match="augmented tables"):
+            lookup(sw, cfg_p, tg, jax.random.PRNGKey(2))
 
     def test_sharded_engine_bit_identical(self):
         from opendht_tpu.parallel import make_mesh
