@@ -14,14 +14,22 @@ native:
 test:
 	python -m pytest tests/ -q
 
-# Static device-invariant analyzer (README "Static analysis").  Three
-# planes: the pure-AST lint (jit hygiene, donated-reuse, lock
-# discipline, ledger registry drift — no JAX import), the lowering
-# plane (every ledger ENTRY_POINTS jit is lowered from its recorded
-# abstract shapes and declared donation must materialize as REAL
+# Static device-invariant analyzer (README "Static analysis").  Six
+# planes: the pure-AST lint (jit hygiene, donated-reuse, ledger
+# registry drift — no JAX import), the package-wide lock-discipline
+# plane (write-outside-lock, check-then-act guard reads, cross-class
+# lock-order cycles — no JAX import), the lowering plane (every
+# ledger ENTRY_POINTS jit is lowered from its recorded abstract
+# shapes and declared donation must materialize as REAL
 # input<->output aliasing in the compiled executable; no f64, no host
-# callbacks), and the strict-mode replay (tier-1 subset under
-# jax_transfer_guard=disallow + rank_promotion=raise + debug_nans).
+# callbacks), the jaxpr interval prover (narrowing casts and u8/u16
+# accumulates proven wrap-free from the same avals), the
+# specialization-budget sweep (each budgeted jit's _cache_size held
+# to the declared ladder budget), and the strict-mode replay (tier-1
+# subset under jax_transfer_guard=disallow + rank_promotion=raise +
+# debug_nans).  A stale-pragma pass then re-judges every suppression
+# against the pre-suppression findings, and a one-line summary
+# (findings per plane, pragma count, budget table) closes the log.
 # Exit 0 = clean; any finding (unsuppressed by a justified
 # `# graftlint: disable=<rule> (<reason>)` pragma) is a failure.
 lint:

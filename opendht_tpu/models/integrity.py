@@ -159,26 +159,46 @@ class SignatureStage:
         worker's verify wall).  A drained stage refuses: counting a
         batch the dead worker will never verify would break the
         ``verified + failed == submitted`` conservation the checker
-        gates."""
-        if self._drained:
-            raise RuntimeError(
-                "SignatureStage.submit after drain: the worker has "
-                "exited — build a fresh stage per measured leg")
-        self.submitted += len(values)
-        self.batches += 1
-        if self.available and values:
-            self._q.put(list(values))
+        gates.  The drained check, the counters AND the enqueue all
+        happen under the stage lock: an unlocked check-then-count
+        raced :meth:`drain` (a batch counted after the drain flag
+        flipped — or enqueued after the worker's stop sentinel — would
+        never be verified), which is exactly the check-then-act shape
+        graftlint's lock plane flags."""
+        with self._lock:
+            if self._drained:
+                raise RuntimeError(
+                    "SignatureStage.submit after drain: the worker "
+                    "has exited — build a fresh stage per measured "
+                    "leg")
+            self.submitted += len(values)
+            self.batches += 1
+            if self.available and values:
+                self._q.put(list(values))
 
     def drain(self) -> dict:
         """Join the worker and return the stage stats.  ``null`` crypto
         figures without the optional dep — the artifact field contract
-        the checker and the crawl mode share."""
-        self._drained = True
-        if self._worker is not None:
-            self._q.put(None)
-            self._worker.join()
-            self._worker = None
+        the checker and the crawl mode share.  The drain flag flips
+        and the stop sentinel enqueues under the same lock
+        :meth:`submit` counts under, so no batch can slip between the
+        flag and the sentinel; the JOIN happens outside it (the worker
+        takes the lock to book its stats — joining under it would
+        deadlock)."""
         with self._lock:
+            first = not self._drained
+            self._drained = True
+            worker = self._worker
+            if first and worker is not None:
+                self._q.put(None)       # stop sentinel, exactly once
+        if worker is not None:
+            # EVERY drainer joins (joining a finished thread is a
+            # no-op): a second concurrent drain() must not return
+            # stats before the in-flight batch is booked, or
+            # verified+failed == submitted breaks for that caller.
+            worker.join()
+        with self._lock:
+            self._worker = None
             if not self.available:
                 return {"available": False, "submitted": self.submitted,
                         "batches": self.batches, "verified": None,

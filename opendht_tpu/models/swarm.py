@@ -581,8 +581,15 @@ def _build_bucket(tables: jax.Array, ids0: jax.Array, b: jax.Array,
     # uniform-random in the reference's steady state too.
     u = jax.random.uniform(key, (n, k))
     strat = (jnp.arange(k, dtype=jnp.float32)[None, :] + u) / k
-    samp = lo[:, None] + jnp.floor(
-        strat * size[:, None]).astype(jnp.int32)
+    # floor(strat·size) ∈ [0, size-1] ⊆ [0, n-1] mathematically
+    # (strat < 1, size ≤ n) — but that bound rides data the interval
+    # prover cannot see through the uniform's bit pipeline.  The clamp
+    # makes it STATIC, so the f32→i32 cast is interval-proven
+    # (graftlint plane 4); on the reachable domain the clamp is an
+    # identity, bit-identical tables either way.
+    samp = lo[:, None] + jnp.clip(
+        jnp.floor(strat * size[:, None]), 0.0,
+        jnp.float32(n - 1)).astype(jnp.int32)
     samp = jnp.clip(samp, lo[:, None], hi[:, None] - 1)
     if alive is not None:
         # samp is an alive-RANK; the (r+1)-th alive node's index is

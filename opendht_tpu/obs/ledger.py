@@ -56,29 +56,39 @@ import numpy as np
 # entry-point registry
 # ---------------------------------------------------------------------------
 
-# (module, attribute, donate_argnums) — the jitted device entry points
-# the ledger wraps.  Donation is recorded from THIS static table (the
-# decorators' donate_argnums; pjit exposes no public introspection for
-# it).  The table is MACHINE-VERIFIED: graftlint's registry-drift rule
-# cross-checks every row against the actual jit decorators by AST
-# (wrong donate_argnums, a vanished entry, or a donating jit missing
-# from this table all fail `make lint`), and plane 2 lowers every row
-# from ledger-recorded avals to prove the declared donation
-# materialized as real input↔output aliasing in the compiled
-# executable.
+# (module, attribute, donate_argnums[, max_specializations]) — the
+# jitted device entry points the ledger wraps.  Donation is recorded
+# from THIS static table (the decorators' donate_argnums; pjit exposes
+# no public introspection for it).  The table is MACHINE-VERIFIED:
+# graftlint's registry-drift rule cross-checks every row against the
+# actual jit decorators by AST (wrong donate_argnums, a vanished
+# entry, or a donating jit missing from this table all fail
+# `make lint`), plane 2 lowers every row from ledger-recorded avals to
+# prove the declared donation materialized as real input↔output
+# aliasing in the compiled executable, and plane 4 interval-proves its
+# narrowed-dtype arithmetic from the same avals.
+#
+# The optional 4th element declares the row's SPECIALIZATION BUDGET:
+# the maximum compiled-program count graftlint's canonical budget
+# sweep may observe for the jit (see graftlint_ranges.
+# canonical_budget_sweep for the exact grid).  The ladder jits declare
+# (compact widths) x (merge rungs) x (lifecycle overlay variants) —
+# the PR-4 "<= log2 L" and PR-14 "<= log2(alpha)+1" promises as gated
+# numbers; an accidental extra static or dtype drift that mints more
+# programs fails `make lint`.
 ENTRY_POINTS: tuple = (
     ("opendht_tpu.models.swarm", "_build_bucket", (0,)),
     ("opendht_tpu.models.swarm", "lookup_init", ()),
-    ("opendht_tpu.models.swarm", "lookup_step", ()),
-    ("opendht_tpu.models.swarm", "_lookup_step_d", (2,)),
+    ("opendht_tpu.models.swarm", "lookup_step", (), 7),
+    ("opendht_tpu.models.swarm", "_lookup_step_d", (2,), 18),
     ("opendht_tpu.models.swarm", "traced_lookup_step", ()),
-    ("opendht_tpu.models.swarm", "_traced_lookup_step_d", (2,)),
+    ("opendht_tpu.models.swarm", "_traced_lookup_step_d", (2,), 9),
     ("opendht_tpu.models.swarm", "chaos_lookup_init", ()),
     ("opendht_tpu.models.swarm", "chaos_lookup_step", ()),
     ("opendht_tpu.models.swarm", "_chaos_step_d", (3,)),
-    ("opendht_tpu.models.swarm", "_compact_slice", (0, 1)),
-    ("opendht_tpu.models.swarm", "_compact_resize", (0, 1)),
-    ("opendht_tpu.models.swarm", "_writeback_prefix", (0,)),
+    ("opendht_tpu.models.swarm", "_compact_slice", (0, 1), 4),
+    ("opendht_tpu.models.swarm", "_compact_resize", (0, 1), 2),
+    ("opendht_tpu.models.swarm", "_writeback_prefix", (0,), 4),
     ("opendht_tpu.models.swarm", "_evict_blacklisted", (0,)),
     ("opendht_tpu.models.swarm", "_finalize", ()),
     ("opendht_tpu.models.swarm", "_finalize_scattered", ()),
@@ -110,7 +120,7 @@ ENTRY_POINTS: tuple = (
     ("opendht_tpu.models.monitor", "fold_sweep", (0,)),
     ("opendht_tpu.parallel.sharded", "_sharded_lookup_while", ()),
     ("opendht_tpu.parallel.sharded", "_sharded_lookup_init", ()),
-    ("opendht_tpu.parallel.sharded", "_sharded_lookup_step", (2,)),
+    ("opendht_tpu.parallel.sharded", "_sharded_lookup_step", (2,), 15),
     ("opendht_tpu.parallel.sharded", "_sharded_compact_slice", (0, 1)),
     ("opendht_tpu.parallel.sharded", "_sharded_compact_resize",
      (0, 1)),
@@ -121,6 +131,15 @@ ENTRY_POINTS: tuple = (
      (0, 1)),
     ("opendht_tpu.parallel.sharded_storage", "_sharded_insert", (2,)),
 )
+
+def entry_row(row) -> tuple:
+    """Normalize an ``ENTRY_POINTS`` row to
+    ``(module, attr, donate_argnums, max_specializations-or-None)`` —
+    the 4th element is optional in the literal."""
+    mod_name, attr, donate = row[0], row[1], tuple(row[2])
+    budget = row[3] if len(row) > 3 else None
+    return mod_name, attr, donate, budget
+
 
 # jits whose compile cache sizes bound the round loop's specializations
 # — the compile-count assertion of bench.py's attribution pass sums
@@ -469,7 +488,8 @@ def instrumented_entry_points(ledger: CostLedger,
     the duration of the block (see :meth:`CostLedger.instrument`)."""
     patched = []
     try:
-        for mod_name, attr, donate in ENTRY_POINTS:
+        for row in ENTRY_POINTS:
+            mod_name, attr, donate, _budget = entry_row(row)
             mod = importlib.import_module(mod_name)
             fn = getattr(mod, attr, None)
             if fn is None or getattr(fn, "_ledger_wrapper", False):

@@ -464,9 +464,17 @@ def rank_merge_round_d0(fr_idx: jax.Array, fr_d0: jax.Array,
     head instead of the former two row scatters — measured 2.3× on
     XLA:CPU at the gate geometry (the scatters alone were ~48 % of the
     merge wall; see BASELINE.md round 18).  Overflow safety of the
-    narrow accumulators is by construction (every count is bounded by
-    S + C) and pinned at the dtype boundaries in
-    ``tests/test_merge_equivalence.py``.
+    narrow accumulators is MACHINE-PROVEN (round 19): graftlint's
+    jaxpr interval prover (``tools/graftlint_ranges.py``, rule
+    ``narrow-overflow``) abstract-interprets every registered entry
+    point's traced program with integer intervals and proves each
+    u8/u16 accumulate in range at the registered widths — a
+    mis-widened plane (width 256 on u8) fails ``make lint``, not just
+    the boundary tests pinned in ``tests/test_merge_equivalence.py``.
+    (The exclusive-rank ``cumsum − 1`` below wraps only in lanes the
+    consuming ``where`` discards; ``sub`` is deliberately outside the
+    checked set, and the prover widens its result to the full domain
+    so nothing downstream can inherit a false proof.)
 
     Returns ``(idx, d0, queried)``, each ``[L, min(keep, S+C)]``.
     """

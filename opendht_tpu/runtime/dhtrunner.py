@@ -101,45 +101,85 @@ class DhtRunner:
         """Start the node (ref: DhtRunner::run dhtrunner.cpp:59-117).
 
         Binds UDP sockets unless explicit transports are given.
+
+        The running check-and-claim is ATOMIC (two threads racing
+        ``run()`` used to both pass the unlocked ``if self._running``
+        guard and double-build the node — the check-then-act shape
+        graftlint's lock plane flags); shared state publishes under
+        the lock BEFORE the transports start delivering packets, and
+        nothing slow (socket bind, transport start, thread start) runs
+        while it is held.
         """
-        if self._running:
-            return
-        config = config or DhtRunnerConfig()
-        if identity is not None:
-            config.dht_config.identity = identity
-        self._threaded = config.threaded
+        with self._lock:
+            if self._running:
+                return
+            self._running = True            # claimed; we build it
+        try:
+            config = config or DhtRunnerConfig()
+            if identity is not None:
+                config.dht_config.identity = identity
+            sched = scheduler or Scheduler(SteadyClock())
+            if transport4 is None and transport6 is None:
+                transport4 = UdpTransport(bind4, port, AF_INET)
+                if bind6 is not None:
+                    transport6 = UdpTransport(bind6, port, AF_INET6)
+            dht = SecureDht(transport4, transport6, config.dht_config,
+                            scheduler=sched, logger=self.log)
+            dht.on_status_changed = self._on_dht_status
+            with self._lock:
+                self._threaded = config.threaded
+                self.scheduler = sched
+                self._t4, self._t6 = transport4, transport6
+                self.dht = dht
 
-        self.scheduler = scheduler or Scheduler(SteadyClock())
-        if transport4 is None and transport6 is None:
-            transport4 = UdpTransport(bind4, port, AF_INET)
-            if bind6 is not None:
-                transport6 = UdpTransport(bind6, port, AF_INET6)
-        self._t4, self._t6 = transport4, transport6
+            for t in (transport4, transport6):
+                if t is None:
+                    continue
+                t.set_receive_callback(self._on_packet)
+                start = getattr(t, "start", None)
+                if start is not None:
+                    start()
+        except BaseException:
+            # A failed build (port in use, bad bind, ...) must release
+            # the claim, or every later run() would return silently at
+            # the guard with the node permanently bricked.
+            with self._lock:
+                self._running = False
+            for t in (transport4, transport6):
+                if t is not None:
+                    try:
+                        t.close()
+                    except Exception:
+                        pass
+            raise
 
-        self.dht = SecureDht(transport4, transport6, config.dht_config,
-                             scheduler=self.scheduler, logger=self.log)
-        self.dht.on_status_changed = self._on_dht_status
-
-        for t in (self._t4, self._t6):
-            if t is None:
-                continue
-            t.set_receive_callback(self._on_packet)
-            start = getattr(t, "start", None)
-            if start is not None:
-                start()
-
-        self._running = True
-        if self._threaded:
-            self._thread = threading.Thread(
+        thread = None
+        if config.threaded:
+            thread = threading.Thread(
                 target=self._loop_forever, name="dht-loop", daemon=True)
-            self._thread.start()
+        with self._lock:
+            alive = self._running
+            if alive and thread is not None:
+                self._thread = thread
+        if not alive:
+            # A concurrent join() stopped the node mid-build: it saw
+            # no thread and no transports, so unwind what we just
+            # started instead of leaving bound sockets with no loop.
+            for t in (transport4, transport6):
+                if t is not None:
+                    t.close()
+            return
+        if thread is not None:
+            thread.start()
 
     def shutdown(self, done_cb: Optional[Callable[[], None]] = None,
                  stop: bool = False) -> None:
         """Flush storage announces (ref: dhtrunner.cpp:119-137)."""
         def op():
-            if self.dht is not None:
-                self.dht.shutdown(done_cb)
+            with self._lock:
+                dht = self.dht
+            if dht is not None:
+                dht.shutdown(done_cb)
         self._post(op, prio=True)
         if stop:
             self.join()
@@ -151,7 +191,9 @@ class DhtRunner:
         Pending priority ops (e.g. the shutdown storage flush) are
         drained before the loop stops so ``shutdown(); join()`` cannot
         silently drop the flush."""
-        if self._thread is not None and self._thread.is_alive():
+        with self._lock:
+            thread = self._thread
+        if thread is not None and thread.is_alive():
             end = _time.monotonic() + 5
             while _time.monotonic() < end:
                 with self._lock:
@@ -161,9 +203,10 @@ class DhtRunner:
         with self._cv:
             self._running = False
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)
         for t in (self._t4, self._t6):
             if t is not None:
                 t.close()
@@ -239,19 +282,25 @@ class DhtRunner:
     # ------------------------------------------------------------------ #
 
     def _on_dht_status(self, s4: str, s6: str) -> None:
-        self._status4, self._status6 = s4, s6
+        # Status lands from the loop thread; get_status()/loop() read
+        # it from API threads — same lock on both sides.
+        with self._lock:
+            self._status4, self._status6 = s4, s6
         status = self.get_status()
         if status == NodeStatus.Disconnected and self._bootstrap_nodes:
             self._try_bootstrap_continuously()
         elif status == NodeStatus.Connected:
-            self._bootstrapping = False
+            with self._lock:
+                self._bootstrapping = False
         if self.on_status_changed:
             self.on_status_changed(s4, s6)
 
     def get_status(self) -> str:
-        if NodeStatus.Connected in (self._status4, self._status6):
+        with self._lock:
+            s4, s6 = self._status4, self._status6
+        if NodeStatus.Connected in (s4, s6):
             return NodeStatus.Connected
-        if NodeStatus.Connecting in (self._status4, self._status6):
+        if NodeStatus.Connecting in (s4, s6):
             return NodeStatus.Connecting
         return NodeStatus.Disconnected
 
@@ -288,40 +337,59 @@ class DhtRunner:
         ``BOOTSTRAP_MAX_TRIES`` fruitless rounds the runner gives up:
         ``_bootstrapping`` clears, which opens the normal-op gate in
         :meth:`loop`, so queued ops (and their futures) complete with
-        failure instead of hanging on an unreachable bootstrap."""
-        if self._bootstrapping or not self._bootstrap_nodes:
-            return
-        self._bootstrapping = True
-        self._bootstrap_tries = 0
-        # Generation token: a connect→disconnect cycle can leave the old
-        # chain's scheduled job pending; without this it would keep
-        # running alongside the new chain, double-counting tries.
-        self._bootstrap_gen += 1
-        gen = self._bootstrap_gen
-        if self._bootstrap_job is not None:
-            self._bootstrap_job.cancel()
+        failure instead of hanging on an unreachable bootstrap.
+
+        Armed from BOTH the loop thread (status change) and API
+        threads (:meth:`bootstrap`), so the check-and-arm is atomic
+        under the runner lock — the unlocked ``if self._bootstrapping``
+        guard used to let two racing callers double-arm the chain and
+        double-count tries (the check-then-act shape graftlint's lock
+        plane flags).  The lock is scoped to the flag edits only:
+        ``_post``/``cancel``/``scheduler.add`` run outside it
+        (``_post`` takes the same non-reentrant lock via ``_cv``)."""
+        with self._lock:
+            if self._bootstrapping or not self._bootstrap_nodes:
+                return
+            self._bootstrapping = True
+            self._bootstrap_tries = 0
+            # Generation token: a connect→disconnect cycle can leave
+            # the old chain's scheduled job pending; without this it
+            # would keep running alongside the new chain, double-
+            # counting tries.
+            self._bootstrap_gen += 1
+            gen = self._bootstrap_gen
+            job, self._bootstrap_job = self._bootstrap_job, None
+        if job is not None:
+            job.cancel()
 
         def retry():
-            if (gen != self._bootstrap_gen or not self._bootstrapping
-                    or not self._running):
-                return
+            with self._lock:
+                if (gen != self._bootstrap_gen
+                        or not self._bootstrapping
+                        or not self._running):
+                    return
             if self.get_status() == NodeStatus.Connected:
-                self._bootstrapping = False
+                with self._lock:
+                    self._bootstrapping = False
                 return
-            self._bootstrap_tries += 1
-            if self._bootstrap_tries > BOOTSTRAP_MAX_TRIES:
+            with self._lock:
+                self._bootstrap_tries += 1
+                tries = self._bootstrap_tries
+            if tries > BOOTSTRAP_MAX_TRIES:
                 # Give up: release the gate and wake the loop so gated
                 # ops run now (they will fail fast on the empty table).
                 # The give-up is permanent for this chain (deliberate
                 # divergence from the reference's retry-forever), so
                 # make it VISIBLE: log + fire the status callback so
                 # callers know to re-bootstrap() if the network heals.
-                self._bootstrapping = False
+                with self._lock:
+                    self._bootstrapping = False
+                    s4, s6 = self._status4, self._status6
                 self.log.w("bootstrap gave up after %d fruitless "
                            "rounds; call bootstrap() to retry",
                            BOOTSTRAP_MAX_TRIES)
                 if self.on_status_changed:
-                    self.on_status_changed(self._status4, self._status6)
+                    self.on_status_changed(s4, s6)
                 with self._cv:
                     self._cv.notify_all()
                 return
@@ -329,8 +397,10 @@ class DhtRunner:
             for host, port in reversed(self._bootstrap_nodes):
                 for addr in self._resolve(host, port):
                     self.dht.ping_node(addr, None)
-            self._bootstrap_job = self.scheduler.add(
+            job2 = self.scheduler.add(
                 self.scheduler.time() + BOOTSTRAP_PERIOD, retry)
+            with self._lock:
+                self._bootstrap_job = job2
 
         self._post(retry, prio=True)
 

@@ -59,6 +59,33 @@ and compiles from those avals and asserts:
 * ``unexercised-entry`` — an ``ENTRY_POINTS`` jit the canonical
   workload never reached (its invariants would be unverified).
 
+**Plane 4 — jaxpr interval prover (``--plane ranges``) and the
+specialization-budget contract (``--plane budget``)** live in
+``graftlint_ranges.py``: every registered jit is traced from the
+ledger-recorded avals and abstract-interpreted with integer intervals
+(``narrow-cast-unproven`` — a narrowing integer cast whose operand
+interval is not proven inside the target domain;
+``narrow-overflow`` — a u8/u16 add/mul/accumulate that may wrap),
+and ``ENTRY_POINTS`` rows carrying ``max_specializations`` are held
+to their declared jit-cache budgets under a canonical ladder sweep
+(``specialization-budget``) — the PR-14 "provably fits" and
+"<= log2(alpha)+1 specializations" claims as machine-checked facts.
+
+**Plane 5 — lock discipline (``--plane lock``).**  A PACKAGE-WIDE
+scan (no hard-coded module list) inventories every class owning a
+``threading.Lock``/``RLock``/``Condition`` and checks:
+
+* ``lock-discipline`` — a shared attribute of a lock-owning class
+  mutated outside ``with self.<lock>`` (the PR-8 scanner race class);
+* ``lock-guard-read`` — check-then-act: a state flag that is written
+  under the lock but READ in an ``if``/``while`` test outside it (the
+  SignatureStage submit-after-drain guard style: flags must be read
+  under the same lock that writes them);
+* ``lock-order`` — the derived cross-class lock-acquisition graph
+  (who calls whom while holding which lock) contains a cycle, or a
+  method calls — under a non-reentrant ``Lock`` — another method of
+  the same class that re-acquires it (self-deadlock).
+
 **Strict-mode replay (``--plane strict``).**  Replays a designated
 tier-1 subset of engine workloads under
 ``jax_transfer_guard=disallow`` + ``jax_numpy_rank_promotion=raise`` +
@@ -73,10 +100,14 @@ pragma on the flagged line or the line above::
 
 The parenthesized reason is mandatory and non-empty; a malformed
 pragma or unknown rule name is itself a finding (``bad-pragma``,
-which is not suppressible).
+which is not suppressible).  STALE pragmas are findings too
+(``stale-pragma``, also unsuppressible): after the planes run, every
+pragma whose rule(s) no longer fire at its site fails the lint — a
+suppression that suppresses nothing is dead documentation.
 
 Exit status: 0 clean, 1 findings, 2 internal error.  ``make lint``
-runs all three planes; CI runs it before the test suite.
+runs every plane and prints a one-line per-plane summary plus the
+budget table; CI runs it before the test suite.
 """
 
 from __future__ import annotations
@@ -118,16 +149,36 @@ RULES: Dict[str, str] = {
                          "canonical lint workload",
     "strict-replay": "workload failed under transfer-guard/"
                      "rank-promotion/debug-nans strict mode",
+    "narrow-cast-unproven": "narrowing integer cast whose operand "
+                            "interval the prover cannot bound inside "
+                            "the target dtype domain",
+    "narrow-overflow": "u8/u16 add/mul/accumulate whose proven result "
+                       "interval escapes the dtype domain (wraparound)",
+    "specialization-budget": "jit compiled more specializations under "
+                             "the canonical sweep than its declared "
+                             "max_specializations budget",
+    "lock-guard-read": "state flag written under a lock but read in a "
+                       "branch test outside it (check-then-act)",
+    "lock-order": "cross-class lock-acquisition cycle, or self-"
+                  "deadlock on a non-reentrant Lock",
+    "stale-pragma": "graftlint pragma whose rule no longer fires at "
+                    "its site (dead suppression)",
+}
+
+# Rules whose findings anchor at real source lines and honor pragmas,
+# grouped by the plane that emits them — the stale-pragma pass only
+# judges pragmas for rules whose plane actually ran this invocation.
+PLANE_RULES = {
+    "ast": ("host-call-in-jit", "tracer-coercion", "sync-in-loop",
+            "unhashable-static", "donated-reuse", "registry-drift",
+            "donation-drop"),
+    "lock": ("lock-discipline", "lock-guard-read", "lock-order"),
+    "ranges": ("narrow-cast-unproven", "narrow-overflow"),
 }
 
 # Modules whose host for/while loops are checked for sync-in-loop.
 SYNC_LOOP_PREFIXES = ("opendht_tpu/models/", "opendht_tpu/parallel/",
                       "opendht_tpu/obs/")
-
-# Modules whose lock-owning classes are held to lock-discipline.
-LOCK_MODULES = ("opendht_tpu/utils/metrics.py",
-                "opendht_tpu/tools/dhtscanner.py",
-                "opendht_tpu/obs/latency.py")
 
 # The five modules whose jit decorators the ledger registry must match.
 # Default module set for DIRECT check_registry calls (tests, embedding).
@@ -225,10 +276,11 @@ def parse_pragmas(src: str, path: str
 def apply_pragmas(findings: Sequence[Finding],
                   pragmas: Dict[int, set]) -> List[Finding]:
     """Drop findings suppressed by a pragma on their line or the line
-    above.  ``bad-pragma`` itself is never suppressible."""
+    above.  ``bad-pragma`` and ``stale-pragma`` are never
+    suppressible."""
     out = []
     for f in findings:
-        if f.rule != "bad-pragma":
+        if f.rule not in ("bad-pragma", "stale-pragma"):
             for ln in (f.line, f.line - 1):
                 if f.rule in pragmas.get(ln, ()):
                     break
@@ -237,6 +289,32 @@ def apply_pragmas(findings: Sequence[Finding],
             continue
         out.append(f)
     return out
+
+
+def suppress_by_source(root: str, findings: Sequence[Finding],
+                       raw_sink: Optional[List[Finding]] = None
+                       ) -> List[Finding]:
+    """Apply each flagged FILE's pragmas to findings that anchor at
+    real source lines (the lock plane and the jaxpr prover both emit
+    those).  ``raw_sink`` receives the pre-suppression findings — the
+    stale-pragma pass needs them to know which pragmas still fire."""
+    if raw_sink is not None:
+        raw_sink.extend(findings)
+    by_file: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_file.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    for path, fs in by_file.items():
+        p = path if os.path.isabs(path) else os.path.join(root, path)
+        if os.path.exists(p):
+            try:
+                with open(p, encoding="utf-8") as fh:
+                    pragmas, _ = parse_pragmas(fh.read(), path)
+                fs = apply_pragmas(fs, pragmas)
+            except OSError:
+                pass
+        out.extend(fs)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
 # ---------------------------------------------------------------------------
@@ -934,13 +1012,18 @@ def _scan_donations(stmts, idx, jit_table, donated: dict, findings):
 
 
 def _lint_lock_discipline(idx: ModuleIndex, findings: List[Finding]):
+    """Per-module lock rules (write-outside-lock + guard-read) —
+    fixture entry; the package-wide plane-5 scan adds the cross-class
+    order graph on top (:func:`lock_lint_sources`)."""
     for node in idx.tree.body:
         if isinstance(node, ast.ClassDef):
-            _lint_lock_class(idx, node, findings)
+            _lock_class_scan(idx, node, findings)
 
 
-def _lock_attrs_of(cls: ast.ClassDef) -> set:
-    locks = set()
+def _lock_attrs_of(cls: ast.ClassDef) -> Dict[str, str]:
+    """``{attr: kind}`` of every ``self.<attr> = threading.Lock()``/
+    ``RLock()``/``Condition()`` the class owns."""
+    locks: Dict[str, str] = {}
     for node in ast.walk(cls):
         if isinstance(node, ast.Assign) and \
                 isinstance(node.value, ast.Call):
@@ -952,7 +1035,7 @@ def _lock_attrs_of(cls: ast.ClassDef) -> set:
                     if isinstance(t, ast.Attribute) and \
                             isinstance(t.value, ast.Name) and \
                             t.value.id == "self":
-                        locks.add(t.attr)
+                        locks[t.attr] = name
     return locks
 
 
@@ -981,63 +1064,321 @@ def _self_attr_of_store(t) -> Optional[Tuple[str, ast.AST]]:
     return None
 
 
-def _lint_lock_class(idx: ModuleIndex, cls: ast.ClassDef,
-                     findings: List[Finding]):
+class LockClassInfo(NamedTuple):
+    """Plane-5 inventory row for one lock-owning class."""
+    path: str
+    name: str
+    line: int
+    locks: Dict[str, str]              # attr -> Lock/RLock/Condition
+    guarded: set                       # attrs written under a lock
+    acquiring: Dict[str, set]          # method -> lock attrs it takes
+    # (held_locks, callee, receiver_is_self, line, col) calls made
+    # while holding locks — the order graph's raw edges
+    calls_under_lock: List[Tuple[frozenset, str, bool, int, int]]
+
+
+_LOCK_INIT_METHODS = ("__init__", "__new__", "__post_init__")
+
+
+def _with_locks_of(w: ast.With, locks) -> set:
+    held = set()
+    for item in w.items:
+        e = item.context_expr
+        if isinstance(e, ast.Attribute) and \
+                isinstance(e.value, ast.Name) and \
+                e.value.id == "self" and e.attr in locks:
+            held.add(e.attr)
+    return held
+
+
+def _lock_class_scan(idx: ModuleIndex, cls: ast.ClassDef,
+                     findings: List[Finding]
+                     ) -> Optional[LockClassInfo]:
+    """Write-rule + guard-read-rule scan of one class; returns the
+    inventory row for the cross-class order graph (None when the
+    class owns no lock)."""
     locks = _lock_attrs_of(cls)
     if not locks:
-        return
+        return None
+    info = LockClassInfo(idx.path, cls.name, cls.lineno, locks, set(),
+                         {}, [])
 
-    def with_holds_lock(w: ast.With) -> bool:
-        for item in w.items:
-            e = item.context_expr
-            if isinstance(e, ast.Attribute) and \
-                    isinstance(e.value, ast.Name) and \
-                    e.value.id == "self" and e.attr in locks:
-                return True
-        return False
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))]
 
-    def scan(stmts, in_lock: bool):
+    # -- pass A: writes.  Collects the guarded set (attrs written
+    # under a lock anywhere, init included — init establishes the
+    # contract) and flags non-init writes outside the lock.  Calls
+    # made while holding a lock are recorded ONCE each (from the
+    # statement's own expressions, not its nested blocks — the
+    # recursion visits those) for the order graph.
+    def own_exprs(s):
+        if isinstance(s, (ast.If, ast.While)):
+            yield s.test
+        elif isinstance(s, ast.For):
+            yield s.iter
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef, ast.Try)):
+            return
+        else:
+            yield s
+
+    def scan_writes(stmts, held: frozenset, report: bool,
+                    method: str):
         for s in stmts:
             if isinstance(s, ast.With):
-                scan(s.body, in_lock or with_holds_lock(s))
+                got = _with_locks_of(s, locks)
+                if got:
+                    info.acquiring.setdefault(method, set()).update(
+                        got)
+                for item in s.items:
+                    _record_calls(item.context_expr, held)
+                scan_writes(s.body, held | frozenset(got), report,
+                            method)
                 continue
             if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                scan(s.body, False)     # closures run on other threads
-                continue
+                scan_writes(s.body, frozenset(), report, method)
+                continue               # closures run on other threads
             if isinstance(s, (ast.Assign, ast.AnnAssign,
                               ast.AugAssign, ast.Delete)):
                 targets = (s.targets if isinstance(
                     s, (ast.Assign, ast.Delete)) else [s.target])
+                flat = []
                 for t in targets:
+                    # tuple-unpack stores (`a, self.x = ...`) mutate
+                    # each element — a gap the DhtRunner status write
+                    # slipped through on the plane's first run
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        flat.extend(t.elts)
+                    else:
+                        flat.append(t)
+                for t in flat:
                     hit = _self_attr_of_store(t)
-                    if hit and not in_lock and hit[0] not in locks:
+                    if hit is None or hit[0] in locks:
+                        continue
+                    if held:
+                        info.guarded.add(hit[0])
+                    elif report:
                         findings.append(Finding(
                             idx.path, t.lineno, t.col_offset,
                             "lock-discipline",
                             f"'self.{hit[0]}' mutated outside 'with "
                             f"self.<lock>' in lock-owning class "
                             f"'{cls.name}'"))
+            for e in own_exprs(s):
+                _record_calls(e, held)
             for attr in ("body", "orelse", "finalbody"):
                 sub = getattr(s, attr, None)
-                if sub and not isinstance(s, (ast.With,)):
-                    scan(sub, in_lock)
+                if sub:
+                    scan_writes(sub, held, report, method)
             for h in getattr(s, "handlers", ()):
-                scan(h.body, in_lock)
+                scan_writes(h.body, held, report, method)
 
-    for node in cls.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if node.name in ("__init__", "__new__", "__post_init__"):
+    def _record_calls(expr, held: frozenset):
+        if not held:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                info.calls_under_lock.append(
+                    (frozenset(held), node.func.attr,
+                     isinstance(recv, ast.Name) and recv.id == "self",
+                     node.lineno, node.col_offset))
+
+    for node in methods:
+        scan_writes(node.body, frozenset(),
+                    node.name not in _LOCK_INIT_METHODS, node.name)
+
+    # -- pass B: guard reads.  A flag the class writes under its lock,
+    # read in an if/while TEST outside the lock, is a check-then-act
+    # race (the SignatureStage submit-after-drain shape).
+    def guarded_read_in(test, in_lock: bool, method: str):
+        if in_lock:
+            return
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and \
+                    node.attr in info.guarded:
+                findings.append(Finding(
+                    idx.path, node.lineno, node.col_offset,
+                    "lock-guard-read",
+                    f"'self.{node.attr}' is written under a lock of "
+                    f"'{cls.name}' but read in a branch test outside "
+                    f"it (in '{method}') — check-then-act: take the "
+                    f"same lock that writes the flag"))
+
+    def scan_reads(stmts, in_lock: bool, method: str):
+        for s in stmts:
+            if isinstance(s, ast.With):
+                held = _with_locks_of(s, locks)
+                scan_reads(s.body, in_lock or bool(held), method)
                 continue
-            scan(node.body, False)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_reads(s.body, False, method)
+                continue
+            if isinstance(s, (ast.If, ast.While)):
+                guarded_read_in(s.test, in_lock, method)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(s, attr, None)
+                if sub:
+                    scan_reads(sub, in_lock, method)
+            for h in getattr(s, "handlers", ()):
+                scan_reads(h.body, in_lock, method)
+
+    for node in methods:
+        if node.name in _LOCK_INIT_METHODS:
+            continue
+        scan_reads(node.body, False, node.name)
+    return info
+
+
+# Container/stdlib method names that must NOT resolve a cross-class
+# lock-order edge by name alone: `self._series.get(...)` under a lock
+# is a dict read, not a call into Metric.get.  Self-receiver calls
+# always resolve (the receiver class is certain).
+_ORDER_DENY = frozenset((
+    "get", "set", "put", "pop", "popleft", "popitem", "append",
+    "appendleft", "add", "remove", "discard", "clear", "update",
+    "extend", "insert", "keys", "values", "items", "setdefault",
+    "move_to_end", "join", "start", "acquire", "release", "wait",
+    "notify", "notify_all", "count", "index", "copy", "sort",
+    "split", "strip", "format", "encode", "decode", "close",
+))
+
+
+def _lock_order_findings(infos: Sequence[LockClassInfo]
+                         ) -> List[Finding]:
+    """Cross-class acquisition-order cycles + same-class Lock
+    re-entry, from the collected call-under-lock edges."""
+    findings: List[Finding] = []
+    by_name = {i.name: i for i in infos}
+    # method name -> classes whose method acquires a lock directly
+    acquirers: Dict[str, List[str]] = {}
+    for i in infos:
+        for meth, lks in i.acquiring.items():
+            if lks:
+                acquirers.setdefault(meth, []).append(i.name)
+
+    edges: Dict[str, set] = {}
+    edge_at: Dict[Tuple[str, str], Tuple[str, int, int]] = {}
+    for i in infos:
+        for held, callee, is_self, line, col in i.calls_under_lock:
+            if is_self:
+                # Self-deadlock only when the callee re-acquires a
+                # lock the caller ALREADY HOLDS and that lock is a
+                # non-reentrant Lock — a disciplined second lock
+                # (held _a, callee takes _b) is ordered nesting, not
+                # a deadlock.
+                re_acq = held & i.acquiring.get(callee, set())
+                bad = sorted(lk for lk in re_acq
+                             if i.locks.get(lk) == "Lock")
+                if bad:
+                    findings.append(Finding(
+                        i.path, line, col, "lock-order",
+                        f"'{i.name}.{callee}' re-acquires the non-"
+                        f"reentrant Lock 'self.{bad[0]}' the caller "
+                        f"already holds — self-deadlock (use an "
+                        f"_unlocked helper or an RLock)"))
+                continue
+            if callee in _ORDER_DENY:
+                continue
+            for target in acquirers.get(callee, ()):
+                if target == i.name:
+                    continue
+                edges.setdefault(i.name, set()).add(target)
+                edge_at.setdefault((i.name, target),
+                                   (i.path, line, col))
+
+    # cycle detection (iterative DFS, report each cycle once)
+    seen_cycles: set = set()
+    for start in sorted(edges):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, ())):
+                if nxt == start:
+                    cyc = tuple(sorted(path))
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    where = edge_at[(node, start)]
+                    chain = " -> ".join(path + [start])
+                    findings.append(Finding(
+                        where[0], where[1], where[2], "lock-order",
+                        f"lock-acquisition cycle across classes: "
+                        f"{chain} — two threads entering from "
+                        f"different ends deadlock; impose one global "
+                        f"order or drop a lock from the chain"))
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return findings
+
+
+def lock_lint_sources(srcs: Dict[str, str]
+                      ) -> Tuple[List[Finding], dict]:
+    """Plane 5 over ``{path: source}``: per-class write + guard-read
+    rules, then the cross-class order graph.  Returns
+    ``(raw findings, inventory summary)`` — pragma application is the
+    caller's job (:func:`run_plane_lock` / tests exercise raw)."""
+    findings: List[Finding] = []
+    infos: List[LockClassInfo] = []
+    for path, src in sorted(srcs.items()):
+        try:
+            idx = ModuleIndex(path, src)
+        except SyntaxError:
+            continue                    # plane 1 reports parse errors
+        for node in idx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                info = _lock_class_scan(idx, node, findings)
+                if info is not None:
+                    infos.append(info)
+    findings.extend(_lock_order_findings(infos))
+    inventory = {
+        "classes": len(infos),
+        "locks": sum(len(i.locks) for i in infos),
+        "guarded_attrs": sum(len(i.guarded) for i in infos),
+        "class_names": sorted(i.name for i in infos),
+    }
+    return findings, inventory
+
+
+def _read_tree(root: str) -> Dict[str, str]:
+    """{relative path: source} of every linted file — read once and
+    shared by the lock plane and the stale-pragma pass."""
+    srcs: Dict[str, str] = {}
+    for path in _iter_files(root):
+        rel = os.path.relpath(path, root)
+        with open(path, encoding="utf-8") as f:
+            srcs[rel] = f.read()
+    return srcs
+
+
+def run_plane_lock(root: str,
+                   raw_sink: Optional[List[Finding]] = None,
+                   srcs: Optional[Dict[str, str]] = None
+                   ) -> Tuple[List[Finding], dict]:
+    """Package-wide plane 5: scan every module for lock-owning
+    classes, apply pragmas per file."""
+    findings, inventory = lock_lint_sources(srcs or _read_tree(root))
+    return suppress_by_source(root, findings,
+                              raw_sink=raw_sink), inventory
 
 
 # ---------------------------------------------------------------------------
 # plane 1: registry drift (ENTRY_POINTS vs decorators, pure AST)
 # ---------------------------------------------------------------------------
 
-def parse_entry_points(ledger_src: str) -> List[Tuple[str, str, Tuple]]:
+def parse_entry_points(ledger_src: str
+                       ) -> List[Tuple[str, str, Tuple,
+                                       Optional[int]]]:
     """Read the ENTRY_POINTS literal out of ledger.py WITHOUT importing
-    it (plane 1 stays JAX-free)."""
+    it (plane 1 stays JAX-free).  Rows normalize to
+    ``(module, attr, donate_argnums, max_specializations-or-None)`` —
+    the budget element is optional in the literal."""
     tree = ast.parse(ledger_src)
     for node in tree.body:
         targets = []
@@ -1048,7 +1389,8 @@ def parse_entry_points(ledger_src: str) -> List[Tuple[str, str, Tuple]]:
         for t in targets:
             if isinstance(t, ast.Name) and t.id == "ENTRY_POINTS":
                 val = ast.literal_eval(node.value)
-                return [(m, a, tuple(d)) for m, a, d in val]
+                return [(r[0], r[1], tuple(r[2]),
+                         r[3] if len(r) > 3 else None) for r in val]
     raise ValueError("ENTRY_POINTS literal not found in ledger source")
 
 
@@ -1081,7 +1423,7 @@ def check_registry(ledger_src: str, module_srcs: Dict[str, str],
     else:
         indices = {mod: ModuleIndex(module_paths.get(mod, mod), src)
                    for mod, src in module_srcs.items()}
-    registered = {(m, a): d for m, a, d in entries}
+    registered = {(m, a): d for m, a, d, _b in entries}
     for (mod, attr), donate in registered.items():
         if mod not in indices:
             # A registered row naming a module outside the scanned
@@ -1168,11 +1510,15 @@ def build_jit_table(root: str, files: Sequence[str]
 def lint_source(src: str, path: str, jit_table=None,
                 sync_loops: Optional[bool] = None,
                 lock_rules: Optional[bool] = None,
-                index: Optional[ModuleIndex] = None) -> List[Finding]:
-    """Plane-1 lint of one source file.  ``sync_loops``/``lock_rules``
-    default from the path (engine modules / designated lock modules)
-    and can be forced for fixture tests.  ``index`` reuses a prebuilt
-    ModuleIndex (run_plane_ast parses each file exactly once)."""
+                index: Optional[ModuleIndex] = None,
+                raw_sink: Optional[List[Finding]] = None
+                ) -> List[Finding]:
+    """Plane-1 lint of one source file.  ``sync_loops`` defaults from
+    the path (engine modules); ``lock_rules=True`` forces the per-
+    class lock rules for fixture tests (the package-wide plane-5 scan
+    owns them otherwise).  ``index`` reuses a prebuilt ModuleIndex
+    (run_plane_ast parses each file exactly once); ``raw_sink``
+    receives pre-suppression findings for the stale-pragma pass."""
     findings: List[Finding] = []
     pragmas, bad = parse_pragmas(src, path)
     findings.extend(bad)
@@ -1217,9 +1563,6 @@ def lint_source(src: str, path: str, jit_table=None,
         _lint_sync_in_loop(idx, traced_nodes, findings)
     _lint_unhashable_static(idx, jit_table, findings)
     _lint_donated_reuse(idx, jit_table, findings)
-    if lock_rules is None:
-        lock_rules = norm in LOCK_MODULES or \
-            any(norm.endswith(m) for m in LOCK_MODULES)
     if lock_rules:
         _lint_lock_discipline(idx, findings)
     # dedup + suppress
@@ -1230,10 +1573,14 @@ def lint_source(src: str, path: str, jit_table=None,
         if key not in seen:
             seen.add(key)
             uniq.append(f)
+    if raw_sink is not None:
+        raw_sink.extend(uniq)
     return apply_pragmas(uniq, pragmas)
 
 
-def run_plane_ast(root: str) -> List[Finding]:
+def run_plane_ast(root: str,
+                  raw_sink: Optional[List[Finding]] = None
+                  ) -> List[Finding]:
     files = _iter_files(root)
     # ONE read + parse per file: the same ModuleIndex feeds the
     # cross-module jit table, the per-file lint, and the registry
@@ -1257,7 +1604,7 @@ def run_plane_ast(root: str) -> List[Finding]:
     findings: List[Finding] = []
     for rel, src, idx, _mod in entries:
         findings.extend(lint_source(src, rel, jit_table=jit_table,
-                                    index=idx))
+                                    index=idx, raw_sink=raw_sink))
     # registry drift
     ledger = os.path.join(root, LEDGER_PATH)
     if os.path.exists(ledger):
@@ -1273,16 +1620,8 @@ def run_plane_ast(root: str) -> List[Finding]:
                                module_indices=module_indices)
         # registry-drift findings respect pragmas in the file they
         # anchor to
-        by_file: Dict[str, List[Finding]] = {}
-        for f in drift:
-            by_file.setdefault(f.path, []).append(f)
-        for path, fs in by_file.items():
-            p = os.path.join(root, path)
-            if os.path.exists(p):
-                with open(p, encoding="utf-8") as fh:
-                    pragmas, _ = parse_pragmas(fh.read(), path)
-                fs = apply_pragmas(fs, pragmas)
-            findings.extend(fs)
+        findings.extend(suppress_by_source(root, drift,
+                                           raw_sink=raw_sink))
     return findings
 
 
@@ -1678,19 +2017,25 @@ def _build_workloads():
     }
 
 
-def run_plane_lower(root: str) -> List[Finding]:
-    """Exercise every ENTRY_POINTS jit under ledger instrumentation,
-    then verify donation→aliasing / f64 / host-callback per entry."""
-    _setup_jax()
-    from ..obs.ledger import ENTRY_POINTS, CostLedger
+_RECORDED_LEDGER = None
+
+
+def recorded_ledger():
+    """One canonical-workload pass per process, shared by plane 2
+    (donation/f64/callback) and plane 4 (the interval prover): run
+    every workload under ledger instrumentation and memoize
+    ``(ledger, workload_findings)``.  Workload CONSTRUCTION runs
+    instrumented too: build_swarm's donated _build_bucket fill is a
+    registered entry point, and its avals are only recorded if the
+    build happens inside the instrument block."""
+    global _RECORDED_LEDGER
+    if _RECORDED_LEDGER is not None:
+        return _RECORDED_LEDGER
+    from ..obs.ledger import CostLedger
 
     findings: List[Finding] = []
     ledger = CostLedger()
     with ledger.instrument():
-        # Workload CONSTRUCTION runs instrumented too: build_swarm's
-        # donated _build_bucket fill is a registered entry point, and
-        # its avals are only recorded if the build happens inside the
-        # instrument block.
         workloads = _build_workloads()
         for name, fn in workloads.items():
             try:
@@ -1698,14 +2043,27 @@ def run_plane_lower(root: str) -> List[Finding]:
             except Exception as e:
                 # One broken workload must not abort the plane as an
                 # internal error: the entries it would have exercised
-                # fall out as per-entry unexercised-entry findings
-                # below, this names the root cause.
+                # fall out as per-entry unexercised-entry findings in
+                # plane 2, this names the root cause.
                 findings.append(Finding(
                     LEDGER_PATH, 1, 0, "unexercised-entry",
                     f"canonical workload '{name}' raised "
                     f"{type(e).__name__}: {e} — the entry points it "
                     f"exercises stay unverified"))
-    for mod_name, attr, donate in ENTRY_POINTS:
+    _RECORDED_LEDGER = (ledger, findings)
+    return _RECORDED_LEDGER
+
+
+def run_plane_lower(root: str) -> List[Finding]:
+    """Exercise every ENTRY_POINTS jit under ledger instrumentation,
+    then verify donation→aliasing / f64 / host-callback per entry."""
+    _setup_jax()
+    from ..obs.ledger import ENTRY_POINTS, entry_row
+
+    ledger, workload_findings = recorded_ledger()
+    findings: List[Finding] = list(workload_findings)
+    for row in ENTRY_POINTS:
+        mod_name, attr, donate, _budget = entry_row(row)
         kname = f"{mod_name.rsplit('.', 1)[-1]}.{attr}"
         rec = ledger.kernels.get(kname)
         if rec is not None and rec.get("aval_args") is False:
@@ -1846,15 +2204,70 @@ def _strict_storage(stg, swarm, cfg, store0, scfg, keys, vals, seqs,
 
 
 # ---------------------------------------------------------------------------
+# stale pragmas
+# ---------------------------------------------------------------------------
+
+def count_pragmas(srcs: Dict[str, str]) -> int:
+    return sum(len(parse_pragmas(src, path)[0])
+               for path, src in srcs.items())
+
+
+def check_stale_pragmas(raw_findings: Sequence[Finding],
+                        rules_checked: set,
+                        srcs: Dict[str, str]) -> List[Finding]:
+    """A ``# graftlint: disable=<rule>`` whose rule no longer fires at
+    its site (same line or the line below — the two positions a pragma
+    suppresses) is dead documentation: the hazard it justified is
+    gone, or moved where the pragma no longer covers it.  Judged
+    against PRE-suppression findings of the planes that ran
+    (``rules_checked``); rules of planes that didn't run are left
+    alone."""
+    fired: Dict[Tuple[str, str], set] = {}
+    for f in raw_findings:
+        fired.setdefault((f.path, f.rule), set()).add(f.line)
+    findings: List[Finding] = []
+    for path, src in sorted(srcs.items()):
+        pragmas, _bad = parse_pragmas(src, path)
+        for ln, rules in sorted(pragmas.items()):
+            for rule in sorted(rules):
+                if rule not in rules_checked:
+                    continue
+                lines = fired.get((path, rule), ())
+                if ln not in lines and ln + 1 not in lines:
+                    findings.append(Finding(
+                        path, ln, 0, "stale-pragma",
+                        f"pragma disables '{rule}' but the rule no "
+                        f"longer fires at this site — remove the "
+                        f"dead suppression (it documents a hazard "
+                        f"that is gone)"))
+    return findings
+
+
+def run_stale_pragmas(root: str, raw_findings: Sequence[Finding],
+                      planes_ran: set,
+                      srcs: Optional[Dict[str, str]] = None
+                      ) -> Tuple[List[Finding], int]:
+    """Tree-wide stale-pragma pass; returns (findings, pragma count)."""
+    srcs = srcs or _read_tree(root)
+    rules_checked: set = set()
+    for plane in planes_ran & set(PLANE_RULES):
+        rules_checked |= set(PLANE_RULES[plane])
+    fs = check_stale_pragmas(raw_findings, rules_checked, srcs)
+    return fs, count_pragmas(srcs)
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
-def _print(findings: Sequence[Finding], plane: str) -> None:
+def _print(findings: Sequence[Finding], plane: str,
+           note: str = "") -> None:
     for f in findings:
         print(f.render())
     n = len(findings)
-    print(f"graftlint[{plane}]: "
-          f"{'clean' if not n else f'{n} finding(s)'}")
+    state = "clean" if not n else f"{n} finding(s)"
+    print(f"graftlint[{plane}]: {state}"
+          + (f" — {note}" if note else ""))
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -1862,14 +2275,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="graftlint",
         description="static device-invariant analyzer "
                     "(see module docstring for the rule catalogue)")
-    ap.add_argument("--plane", choices=("ast", "lower", "strict",
+    ap.add_argument("--plane", choices=("ast", "lock", "lower",
+                                        "ranges", "budget", "strict",
                                         "all"),
                     default="all",
-                    help="ast: pure-AST lint, no JAX import; lower: "
-                         "donation/f64/callback checks on every "
-                         "ledger entry point; strict: tier-1 subset "
-                         "replay under transfer-guard/rank-promotion/"
-                         "debug-nans; all: everything")
+                    help="ast: pure-AST lint, no JAX import; lock: "
+                         "package-wide lock-discipline plane (pure "
+                         "AST); lower: donation/f64/callback checks "
+                         "on every ledger entry point; ranges: jaxpr "
+                         "interval prover over the same entries; "
+                         "budget: specialization-budget sweep; "
+                         "strict: tier-1 subset replay under "
+                         "transfer-guard/rank-promotion/debug-nans; "
+                         "all: everything + stale-pragma check")
     ap.add_argument("--root", default=None,
                     help="repo root (default: auto-detect from this "
                          "file's location)")
@@ -1878,30 +2296,81 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = ap.parse_args(argv)
     if args.list_rules:
         for rule, desc in RULES.items():
-            print(f"{rule:20s} {desc}")
+            print(f"{rule:22s} {desc}")
         return 0
     root = args.root or os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
-    total = 0
+    counts: Dict[str, int] = {}
+    raw: List[Finding] = []
+    ran: set = set()
+    budget_table: dict = {}
+    pragma_count = None
     try:
         if args.plane in ("ast", "all"):
-            fs = run_plane_ast(root)
+            fs = run_plane_ast(root, raw_sink=raw)
             _print(fs, "ast")
-            total += len(fs)
+            counts["ast"] = len(fs)
+            ran.add("ast")
+        tree_srcs: Optional[Dict[str, str]] = None
+        if args.plane in ("lock", "all"):
+            tree_srcs = _read_tree(root)
+            fs, inv = run_plane_lock(root, raw_sink=raw,
+                                     srcs=tree_srcs)
+            _print(fs, "lock",
+                   f"{inv['classes']} lock-owning classes, "
+                   f"{inv['locks']} locks, {inv['guarded_attrs']} "
+                   f"guarded attrs")
+            counts["lock"] = len(fs)
+            ran.add("lock")
         if args.plane in ("lower", "all"):
             fs = run_plane_lower(root)
             _print(fs, "lower")
-            total += len(fs)
+            counts["lower"] = len(fs)
+        if args.plane in ("ranges", "all"):
+            from .graftlint_ranges import run_plane_ranges
+            fs, st = run_plane_ranges(root, raw_sink=raw)
+            _print(fs, "ranges",
+                   f"{st['entries']} entries interval-proven, "
+                   f"{st['casts_proven']} narrowing casts + "
+                   f"{st['accums_proven']} narrow accumulates in "
+                   f"range")
+            counts["ranges"] = len(fs)
+            ran.add("ranges")
+        if args.plane in ("budget", "all"):
+            from .graftlint_ranges import run_plane_budget
+            fs, budget_table = run_plane_budget(root)
+            _print(fs, "budget",
+                   " ".join(f"{k}={v['measured']}/{v['budget']}"
+                            for k, v in budget_table.items()))
+            counts["budget"] = len(fs)
         if args.plane in ("strict", "all"):
             fs = run_plane_strict(root)
             _print(fs, "strict")
-            total += len(fs)
+            counts["strict"] = len(fs)
+        if ran:
+            fs, pragma_count = run_stale_pragmas(root, raw, ran,
+                                                 srcs=tree_srcs)
+            _print(fs, "pragmas",
+                   f"{pragma_count} pragma(s) in tree, "
+                   f"{len(fs)} stale")
+            counts["pragmas"] = len(fs)
+        # the one-line coverage summary the gate logs grep for
+        parts = " ".join(f"{k}={v}" for k, v in counts.items())
+        extras = []
+        if pragma_count is not None:
+            extras.append(f"pragmas={pragma_count}")
+        if budget_table:
+            extras.append("budgets[" + " ".join(
+                f"{k}={v['measured']}/{v['budget']}"
+                for k, v in budget_table.items()) + "]")
+        print(f"graftlint summary: {parts}"
+              + ((" | " + " | ".join(extras)) if extras else ""))
     except Exception as e:
         import traceback
         traceback.print_exc()
         print(f"graftlint: internal error: {type(e).__name__}: {e}")
         return 2
-    return 1 if total else 0
+    return 1 if sum(counts.values()) else 0
 
 
 if __name__ == "__main__":

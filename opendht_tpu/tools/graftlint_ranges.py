@@ -1,0 +1,817 @@
+"""graftlint planes 4 (jaxpr interval prover) + specialization budgets.
+
+PR 14 narrowed the rank-merge accumulators to "the smallest unsigned
+dtype the width provably fits" and promised the width ladder costs
+"<= log2(alpha)+1 extra specializations" — both claims lived in
+comments and boundary tests.  This module turns them into
+machine-checked facts over the programs the engine actually runs:
+
+**Plane 4 — jaxpr interval prover (``--plane ranges``).**  Every
+registered ``ENTRY_POINTS`` jit is traced from the ledger-recorded
+abstract shapes (the plane-2 machinery, reused) and its
+``ClosedJaxpr`` is abstract-interpreted with integer INTERVALS seeded
+from dtype domains and the static widths baked into the program
+(shapes, iota sizes, literals).  The prover checks, at every
+equation:
+
+* ``narrow-cast-unproven`` — a ``convert_element_type`` to a NARROWER
+  integer dtype (fewer bytes, or float source) whose operand interval
+  is not proven inside the target domain.  A narrowing cast the
+  prover cannot bound is a finding even if tests happen to pass — the
+  round-18 "provably fits" comment becomes this proof;
+* ``narrow-overflow`` — an ``add``/``mul``/``cumsum``/``reduce_sum``/
+  ``scatter-add`` whose OUTPUT dtype is u8/u16 and whose exact
+  (mathematical) result interval escapes the dtype domain: the
+  accumulator would wrap.  Sub-u8 wraparound in masked lanes is NOT
+  checked (the merge's exclusive-rank ``cumsum - 1`` idiom wraps only
+  in lanes the consuming ``where`` discards); interval propagation
+  stays sound by widening any out-of-domain unchecked result to the
+  full dtype domain.
+
+Findings anchor at the REAL source line of the offending equation
+(jaxpr ``source_info``), so the existing mandatory-reason pragma
+grammar suppresses them like any plane-1 rule.
+
+**Specialization budgets (``--plane budget``).**  ``ENTRY_POINTS``
+rows may declare ``max_specializations``; a canonical sweep drives
+every declared ladder shape (compact widths x merge-width rungs —
+the exact grid the burst loops can reach) plus the natural engine
+legs, then asserts each budgeted jit's ``_cache_size()`` stays within
+its declared budget (``specialization-budget`` findings otherwise).
+The width ladder's ``<= log2(alpha)+1`` and the compaction ladder's
+``<= log2 L`` promises become gated facts: an accidental unhashable
+static or dtype drift that mints extra compiled programs fails
+``make lint`` instead of surfacing as a mystery compile wall in a
+bench.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+from .graftlint import Finding
+
+LEDGER_PATH = "opendht_tpu/obs/ledger.py"
+
+NEG_INF = float("-inf")
+POS_INF = float("inf")
+
+# dtypes whose checked accumulations must prove no wraparound — the
+# round-18 narrowed rank planes.  i32 overflow needs ~2^31 candidates
+# (not a reachable geometry); u8/u16 overflow needs 256 — one width
+# drift away.
+_CHECKED_NARROW = ("uint8", "uint16")
+
+# primitives treated as accumulations for the narrow-overflow rule
+_ACCUM_PRIMS = ("add", "mul", "cumsum", "reduce_sum", "scatter-add")
+
+
+class IV(NamedTuple):
+    """Closed integer/real interval [lo, hi]; +-inf = unbounded."""
+    lo: float
+    hi: float
+
+    def known(self) -> bool:
+        return self.lo > NEG_INF and self.hi < POS_INF
+
+    def within(self, other: "IV") -> bool:
+        return self.lo >= other.lo and self.hi <= other.hi
+
+
+TOP = IV(NEG_INF, POS_INF)
+
+
+def _dtype_domain(dtype) -> IV:
+    import numpy as np
+    try:
+        dt = np.dtype(dtype)
+    except TypeError:
+        return TOP               # extended dtypes (PRNG keys, ...)
+    if dt == np.bool_:
+        return IV(0, 1)
+    if np.issubdtype(dt, np.integer):
+        info = np.iinfo(dt)
+        return IV(int(info.min), int(info.max))
+    return TOP                   # floats: value range unbounded
+
+
+def _iv_of_value(val) -> IV:
+    import numpy as np
+    try:
+        arr = np.asarray(val)
+        if arr.size == 0:
+            return IV(0, 0)
+        if arr.dtype == np.bool_:
+            return IV(int(arr.min()), int(arr.max()))
+        if np.issubdtype(arr.dtype, np.integer):
+            return IV(int(arr.min()), int(arr.max()))
+        if np.issubdtype(arr.dtype, np.floating):
+            lo, hi = float(arr.min()), float(arr.max())
+            if math.isfinite(lo) and math.isfinite(hi):
+                return IV(lo, hi)
+        return TOP
+    except Exception:
+        return TOP
+
+
+def _add(a: IV, b: IV) -> IV:
+    return IV(a.lo + b.lo, a.hi + b.hi)
+
+
+def _sub(a: IV, b: IV) -> IV:
+    return IV(a.lo - b.hi, a.hi - b.lo)
+
+
+def _mul1(x: float, y: float) -> float:
+    # inf * 0 is nan under IEEE; interval endpoints want 0.
+    if x == 0 or y == 0:
+        return 0
+    return x * y
+
+
+def _mul(a: IV, b: IV) -> IV:
+    ps = (_mul1(a.lo, b.lo), _mul1(a.lo, b.hi),
+          _mul1(a.hi, b.lo), _mul1(a.hi, b.hi))
+    return IV(min(ps), max(ps))
+
+
+def _join(*ivs: IV) -> IV:
+    return IV(min(i.lo for i in ivs), max(i.hi for i in ivs))
+
+
+def _bitlen_bound(a: IV, b: IV) -> IV:
+    """or/xor of two proven-nonnegative ints is bounded by the next
+    all-ones mask covering both."""
+    if a.lo < 0 or b.lo < 0 or not (a.known() and b.known()):
+        return TOP
+    bits = max(int(a.hi).bit_length(), int(b.hi).bit_length())
+    return IV(0, (1 << bits) - 1)
+
+
+def _source_of(eqn, root: Optional[str]) -> Tuple[str, int]:
+    """(repo-relative path, line) of the user frame that built this
+    equation — the anchor the pragma grammar suppresses at."""
+    try:
+        from jax._src import source_info_util
+        fr = source_info_util.user_frame(eqn.source_info)
+        if fr is None:
+            return LEDGER_PATH, 1
+        path = fr.file_name
+        if root:
+            try:
+                rel = os.path.relpath(path, root)
+                if not rel.startswith(".."):
+                    path = rel
+            except ValueError:
+                pass
+        return path, int(fr.start_line)
+    except Exception:
+        return LEDGER_PATH, 1
+
+
+class RangeChecker:
+    """Finding collector + proof counters for one prover run."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root
+        self.findings: List[Finding] = []
+        self._seen: set = set()
+        self.entries_checked = 0
+        self.casts_proven = 0
+        self.accums_proven = 0
+
+    def _emit(self, eqn, rule: str, msg: str):
+        path, line = _source_of(eqn, self.root)
+        key = (path, line, rule)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(path, line, 0, rule, msg))
+
+
+def _shape_of(var):
+    aval = getattr(var, "aval", None)
+    return tuple(getattr(aval, "shape", ()) or ())
+
+
+def _dtype_name(var) -> str:
+    aval = getattr(var, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    return str(dt) if dt is not None else ""
+
+
+def _is_int_dtype(name: str) -> bool:
+    return name.startswith("int") or name.startswith("uint")
+
+
+def _settle(iv: IV, dtype_name: str) -> IV:
+    """Clamp a propagated interval to its dtype's representable
+    domain; an integer result that escapes the domain WRAPS, so the
+    sound abstraction is the full domain, not a clamp."""
+    dom = _dtype_domain(dtype_name)
+    if dom is TOP:
+        return iv
+    if iv.within(dom):
+        return iv
+    if _is_int_dtype(dtype_name) or dtype_name == "bool":
+        return dom
+    return iv
+
+
+def _reduced_count(eqn) -> int:
+    """Number of elements folded into each output lane of a reduce."""
+    shape = _shape_of(eqn.invars[0])
+    axes = eqn.params.get("axes", ())
+    n = 1
+    for ax in axes:
+        if 0 <= ax < len(shape):
+            n *= int(shape[ax])
+    return n
+
+
+def interp_jaxpr(jaxpr, consts: Sequence, in_ivs: Sequence[IV],
+                 ck: RangeChecker, entry: str,
+                 depth: int = 0) -> List[IV]:
+    """Abstract-interpret one ``core.Jaxpr`` with intervals; returns
+    output intervals and emits findings through ``ck``.  Unknown
+    primitives degrade soundly to their output dtype domain."""
+    env: Dict = {}
+
+    def write(var, iv: IV):
+        env[id(var)] = _settle(iv, _dtype_name(var))
+
+    def read(atom) -> IV:
+        # Literal?
+        val = getattr(atom, "val", None)
+        if val is not None or type(atom).__name__ == "Literal":
+            return _iv_of_value(val)
+        got = env.get(id(atom))
+        if got is not None:
+            return got
+        return _dtype_domain(_dtype_name(atom) or "float64")
+
+    for var, const in zip(jaxpr.constvars, consts):
+        write(var, _iv_of_value(const))
+    for var, iv in zip(jaxpr.invars, in_ivs):
+        write(var, iv)
+
+    for eqn in jaxpr.eqns:
+        p = eqn.primitive.name
+        ivs = [read(a) for a in eqn.invars]
+        out_dt = _dtype_name(eqn.outvars[0]) if eqn.outvars else ""
+        outs = _eval_prim(p, eqn, ivs, out_dt, ck, entry, depth)
+        if outs is None:                       # unknown primitive
+            outs = [_dtype_domain(_dtype_name(v)) for v in eqn.outvars]
+        for var, iv in zip(eqn.outvars, outs):
+            write(var, iv)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _subjaxpr(obj):
+    """ClosedJaxpr-or-Jaxpr -> (jaxpr, consts)."""
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None:
+        return inner, list(getattr(obj, "consts", ()) or ())
+    return obj, []
+
+
+def _check_accum(p: str, eqn, result: IV, out_dt: str,
+                 ck: RangeChecker, entry: str) -> IV:
+    """narrow-overflow check for an accumulation on a u8/u16 plane."""
+    dom = _dtype_domain(out_dt)
+    if out_dt not in _CHECKED_NARROW:
+        return result
+    if not result.known() or not result.within(dom):
+        lo = "-inf" if result.lo == NEG_INF else int(result.lo)
+        hi = "+inf" if result.hi == POS_INF else int(result.hi)
+        ck._emit(eqn, "narrow-overflow",
+                 f"'{p}' on {out_dt} may wrap in {entry}: result "
+                 f"interval [{lo}, {hi}] escapes [{int(dom.lo)}, "
+                 f"{int(dom.hi)}] — widen the accumulator or bound "
+                 f"the operands")
+        return dom
+    ck.accums_proven += 1
+    return result
+
+
+def _eval_prim(p: str, eqn, ivs: List[IV], out_dt: str,
+               ck: RangeChecker, entry: str,
+               depth: int) -> Optional[List[IV]]:
+    params = eqn.params
+    # ---- arithmetic ------------------------------------------------
+    if p == "add":
+        r = _add(ivs[0], ivs[1])
+        return [_check_accum(p, eqn, r, out_dt, ck, entry)]
+    if p == "mul":
+        r = _mul(ivs[0], ivs[1])
+        return [_check_accum(p, eqn, r, out_dt, ck, entry)]
+    if p == "sub":
+        return [_sub(ivs[0], ivs[1])]
+    if p == "neg":
+        return [IV(-ivs[0].hi, -ivs[0].lo)]
+    if p == "abs":
+        a = ivs[0]
+        lo = 0 if a.lo <= 0 <= a.hi else min(abs(a.lo), abs(a.hi))
+        return [IV(lo, max(abs(a.lo), abs(a.hi)))]
+    if p == "sign":
+        return [IV(-1, 1)]
+    if p == "max":
+        return [IV(max(ivs[0].lo, ivs[1].lo), max(ivs[0].hi, ivs[1].hi))]
+    if p == "min":
+        return [IV(min(ivs[0].lo, ivs[1].lo), min(ivs[0].hi, ivs[1].hi))]
+    if p == "clamp":            # clamp(lo_c, x, hi_c)
+        lo_c, x, hi_c = ivs
+        m = IV(max(x.lo, lo_c.lo), max(x.hi, lo_c.hi))
+        return [IV(min(m.lo, hi_c.lo), min(m.hi, hi_c.hi))]
+    if p == "rem":
+        b = ivs[1]
+        if b.known() and b.lo > 0 and ivs[0].lo >= 0:
+            return [IV(0, b.hi - 1)]
+        return None
+    if p in ("floor", "ceil", "round", "nextafter"):
+        a = ivs[0]
+        lo = math.floor(a.lo) if math.isfinite(a.lo) else a.lo
+        hi = math.ceil(a.hi) if math.isfinite(a.hi) else a.hi
+        return [IV(lo, hi)]
+    if p == "integer_pow":
+        y = params.get("y", 0)
+        if y == 2:
+            return [_mul(ivs[0], ivs[0])]
+        return None
+    # ---- comparisons / logic (bool outputs) ------------------------
+    if p in ("eq", "ne", "lt", "le", "gt", "ge", "is_finite",
+             "reduce_or", "reduce_and", "eq_to", "le_to", "lt_to"):
+        return [IV(0, 1) for _ in eqn.outvars]
+    if p in ("and", "or", "xor", "not"):
+        if out_dt == "bool":
+            return [IV(0, 1)]
+        if p == "and":
+            a, b = ivs
+            if a.lo >= 0 and b.lo >= 0:
+                return [IV(0, min(a.hi, b.hi))]
+            return None
+        if p in ("or", "xor"):
+            return [_bitlen_bound(ivs[0], ivs[1])]
+        return None
+    # ---- shifts ----------------------------------------------------
+    if p == "shift_right_logical":
+        a, s = ivs
+        if a.lo >= 0 and s.known() and s.lo >= 0 and a.known():
+            return [IV(int(a.lo) >> int(s.hi), int(a.hi) >> int(s.lo))]
+        dom = _dtype_domain(out_dt)
+        return [IV(0, dom.hi) if dom is not TOP else TOP]
+    if p == "shift_right_arithmetic":
+        a, s = ivs
+        if a.lo >= 0 and s.known() and s.lo >= 0 and a.known():
+            return [IV(int(a.lo) >> int(s.hi), int(a.hi) >> int(s.lo))]
+        return None
+    if p == "shift_left":
+        a, s = ivs
+        if a.lo >= 0 and s.known() and s.lo >= 0 and a.known():
+            return [IV(int(a.lo) << int(s.lo), int(a.hi) << int(s.hi))]
+        return None
+    if p in ("clz", "population_count"):
+        bits = 8 * max(1, _dtype_itemsize(out_dt))
+        return [IV(0, bits)]
+    # ---- the narrowing-cast check ----------------------------------
+    if p == "convert_element_type":
+        src_dt = _dtype_name(eqn.invars[0])
+        dst_dt = str(params.get("new_dtype", out_dt))
+        return [_check_cast(eqn, ivs[0], src_dt, dst_dt, ck, entry)]
+    # ---- structure-preserving --------------------------------------
+    if p in ("broadcast_in_dim", "reshape", "squeeze", "expand_dims",
+             "transpose", "rev", "copy", "stop_gradient", "slice",
+             "dynamic_slice", "reduce_max", "reduce_min", "cummax",
+             "cummin", "reduce_precision", "real", "optimization_barrier"):
+        return [ivs[0] for _ in eqn.outvars]
+    if p == "dynamic_update_slice":
+        return [_join(ivs[0], ivs[1])]
+    if p == "concatenate":
+        return [_join(*ivs)]
+    if p == "pad":
+        return [_join(ivs[0], ivs[1])]
+    if p == "select_n":
+        return [_join(*ivs[1:])]
+    if p == "gather":
+        return [ivs[0]]
+    if p == "scatter":
+        return [_join(ivs[0], ivs[2] if len(ivs) > 2 else ivs[-1])]
+    if p in ("scatter-max", "scatter-min"):
+        return [_join(ivs[0], ivs[-1])]
+    if p == "scatter-add":
+        op, upd = ivs[0], ivs[-1]
+        n_upd = 1
+        for d in _shape_of(eqn.invars[-1]):
+            n_upd *= int(d)
+        r = IV(op.lo + _mul1(n_upd, min(0, upd.lo)),
+               op.hi + _mul1(n_upd, max(0, upd.hi)))
+        return [_check_accum(p, eqn, r, out_dt, ck, entry)]
+    # ---- reductions / scans ----------------------------------------
+    if p == "reduce_sum":
+        n = _reduced_count(eqn)
+        a = ivs[0]
+        r = IV(_mul1(n, a.lo), _mul1(n, a.hi))
+        return [_check_accum(p, eqn, r, out_dt, ck, entry)]
+    if p == "cumsum":
+        shape = _shape_of(eqn.invars[0])
+        ax = params.get("axis", 0)
+        n = int(shape[ax]) if 0 <= ax < len(shape) else 1
+        a = ivs[0]
+        r = IV(min(a.lo, _mul1(n, a.lo)), max(a.hi, _mul1(n, a.hi)))
+        return [_check_accum(p, eqn, r, out_dt, ck, entry)]
+    if p in ("argmax", "argmin"):
+        shape = _shape_of(eqn.invars[0])
+        axes = params.get("axes", ())
+        n = 1
+        for ax in axes:
+            if 0 <= ax < len(shape):
+                n *= int(shape[ax])
+        return [IV(0, max(0, n - 1))]
+    if p == "iota":
+        shape = params.get("shape", ())
+        dim = params.get("dimension", 0)
+        n = int(shape[dim]) if 0 <= dim < len(shape) else 1
+        return [_settle(IV(0, max(0, n - 1)), out_dt)]
+    if p == "sort":
+        return list(ivs)
+    if p == "top_k":
+        n = 1
+        shape = _shape_of(eqn.invars[0])
+        if shape:
+            n = int(shape[-1])
+        return [ivs[0], IV(0, max(0, n - 1))]
+    # ---- higher-order ----------------------------------------------
+    if p in ("pjit", "closed_call", "core_call", "remat", "checkpoint",
+             "custom_jvp_call", "custom_vjp_call", "shard_map",
+             "custom_vjp_call_jaxpr"):
+        sub = params.get("jaxpr") or params.get("call_jaxpr") or \
+            params.get("fun_jaxpr")
+        if sub is None:
+            return None
+        inner, consts = _subjaxpr(sub)
+        n_in = len(inner.invars)
+        outs = interp_jaxpr(inner, consts, (ivs + [TOP] * n_in)[:n_in],
+                            ck, entry, depth + 1)
+        return outs[:len(eqn.outvars)] + \
+            [TOP] * max(0, len(eqn.outvars) - len(outs))
+    if p == "cond":
+        branches = params.get("branches", ())
+        all_outs = []
+        for br in branches:
+            inner, consts = _subjaxpr(br)
+            n_in = len(inner.invars)
+            ops = (ivs[1:] + [TOP] * n_in)[:n_in]
+            all_outs.append(interp_jaxpr(inner, consts, ops, ck,
+                                         entry, depth + 1))
+        if not all_outs:
+            return None
+        outs = []
+        for k in range(len(eqn.outvars)):
+            cols = [o[k] if k < len(o) else TOP for o in all_outs]
+            outs.append(_join(*cols))
+        return outs
+    if p == "while":
+        # Carry is iterated an unknown number of times: seed it with
+        # the dtype domain (sound fixpoint in one pass) and interpret
+        # cond+body once each for their checks.
+        cj, bj = params.get("cond_jaxpr"), params.get("body_jaxpr")
+        cn = params.get("cond_nconsts", 0)
+        bn = params.get("body_nconsts", 0)
+        carry = eqn.invars[cn + bn:]
+        carry_ivs = [_dtype_domain(_dtype_name(v)) for v in carry]
+        if cj is not None:
+            inner, consts = _subjaxpr(cj)
+            interp_jaxpr(inner, consts, ivs[:cn] + carry_ivs, ck,
+                         entry, depth + 1)
+        if bj is not None:
+            inner, consts = _subjaxpr(bj)
+            interp_jaxpr(inner, consts, ivs[cn:cn + bn] + carry_ivs,
+                         ck, entry, depth + 1)
+        return list(carry_ivs)
+    if p == "scan":
+        sub = params.get("jaxpr")
+        if sub is None:
+            return None
+        inner, consts = _subjaxpr(sub)
+        n_consts = params.get("num_consts", 0)
+        n_carry = params.get("num_carry", 0)
+        carry_vars = eqn.invars[n_consts:n_consts + n_carry]
+        carry_ivs = [_dtype_domain(_dtype_name(v)) for v in carry_vars]
+        xs_ivs = ivs[n_consts + n_carry:]
+        body_in = ivs[:n_consts] + carry_ivs + xs_ivs
+        n_in = len(inner.invars)
+        outs = interp_jaxpr(inner, consts, (body_in + [TOP] * n_in)[:n_in],
+                            ck, entry, depth + 1)
+        ys = outs[n_carry:]
+        return carry_ivs + ys + \
+            [TOP] * max(0, len(eqn.outvars) - n_carry - len(ys))
+    return None                                 # unknown primitive
+
+
+def _dtype_itemsize(name: str) -> int:
+    import numpy as np
+    try:
+        return np.dtype(name).itemsize
+    except TypeError:
+        return 0
+
+
+def _check_cast(eqn, iv: IV, src_dt: str, dst_dt: str,
+                ck: RangeChecker, entry: str) -> IV:
+    """The plane-4 core rule: a value-narrowing integer cast must
+    carry a proven-in-range operand interval."""
+    dom = _dtype_domain(dst_dt)
+    if not _is_int_dtype(dst_dt):
+        return iv if dst_dt != "bool" else IV(0, 1)
+    if dst_dt == "bool" or src_dt == "bool":
+        return IV(0, 1) if dst_dt == "bool" else iv
+    src_float = not _is_int_dtype(src_dt)
+    narrowing = src_float or (
+        _dtype_itemsize(dst_dt) < _dtype_itemsize(src_dt))
+    if narrowing:
+        if iv.known() and iv.within(dom):
+            ck.casts_proven += 1
+            return iv
+        lo = "-inf" if iv.lo == NEG_INF else f"{iv.lo:g}"
+        hi = "+inf" if iv.hi == POS_INF else f"{iv.hi:g}"
+        ck._emit(eqn, "narrow-cast-unproven",
+                 f"cast {src_dt}->{dst_dt} in {entry} not proven in "
+                 f"range: operand interval [{lo}, {hi}] vs domain "
+                 f"[{int(dom.lo)}, {int(dom.hi)}] — clamp the operand "
+                 f"to a static bound or widen the target dtype")
+        return dom
+    # Same- or wider-width int casts reinterpret/extend: a negative
+    # into unsigned is the engine's deliberate sentinel trick —
+    # unchecked, but the result must stay inside the new domain.
+    if iv.within(dom):
+        return iv
+    return dom
+
+
+# ---------------------------------------------------------------------------
+# plane-4 driver
+# ---------------------------------------------------------------------------
+
+def check_entry_ranges(fn, name: str, aval_args,
+                       ck: RangeChecker) -> None:
+    """Trace ``fn`` from recorded abstract args and interval-check the
+    ClosedJaxpr.  Input arrays are seeded with their dtype domain —
+    everything the prover learns beyond that comes from the program's
+    own static structure."""
+    args, kwargs = aval_args
+    try:
+        closed = fn.trace(*args, **kwargs).jaxpr
+    except Exception as e:
+        ck.findings.append(Finding(
+            LEDGER_PATH, 1, 0, "narrow-cast-unproven",
+            f"{name}: cannot trace from ledger avals for the interval "
+            f"prover: {type(e).__name__}: {e}"))
+        return
+    jaxpr = closed.jaxpr
+    in_ivs = [_dtype_domain(_dtype_name(v)) for v in jaxpr.invars]
+    interp_jaxpr(jaxpr, list(closed.consts), in_ivs, ck, name)
+    ck.entries_checked += 1
+
+
+def run_plane_ranges(root: str,
+                     raw_sink: Optional[List[Finding]] = None
+                     ) -> Tuple[List[Finding], dict]:
+    """Plane 4 over every ENTRY_POINTS jit with recorded avals.
+    Returns (post-pragma findings, stats-dict for the summary line)."""
+    from . import graftlint as gl
+
+    gl._setup_jax()
+    from ..obs.ledger import ENTRY_POINTS, entry_row
+
+    ledger, workload_findings = gl.recorded_ledger()
+    ck = RangeChecker(root=root)
+    for row in ENTRY_POINTS:
+        mod_name, attr, _donate, _budget = entry_row(row)
+        kname = f"{mod_name.rsplit('.', 1)[-1]}.{attr}"
+        rec = ledger.kernels.get(kname)
+        if rec is None or not rec.get("aval_args") or \
+                rec.get("fn") is None:
+            continue            # plane 2 reports unexercised entries
+        check_entry_ranges(rec["fn"], kname, rec["aval_args"], ck)
+    findings = gl.suppress_by_source(root, ck.findings,
+                                     raw_sink=raw_sink)
+    stats = {"entries": ck.entries_checked,
+             "casts_proven": ck.casts_proven,
+             "accums_proven": ck.accums_proven}
+    return findings, stats
+
+
+# ---------------------------------------------------------------------------
+# specialization-budget plane
+# ---------------------------------------------------------------------------
+
+def check_budgets(measured: Dict[str, Optional[int]],
+                  budgets: Dict[str, int],
+                  ep_line: int = 1) -> List[Finding]:
+    """Pure contract check: every budgeted jit's measured compiled-
+    specialization count must not exceed its declared budget (and must
+    have been measured at all)."""
+    findings: List[Finding] = []
+    for name, budget in sorted(budgets.items()):
+        got = measured.get(name)
+        if got is None:
+            findings.append(Finding(
+                LEDGER_PATH, ep_line, 0, "specialization-budget",
+                f"{name}: declared max_specializations={budget} but "
+                f"the budget sweep never measured its cache (entry "
+                f"renamed, or the sweep lost its leg?)"))
+        elif got > budget:
+            findings.append(Finding(
+                LEDGER_PATH, ep_line, 0, "specialization-budget",
+                f"{name}: {got} compiled specializations after the "
+                f"canonical sweep exceed the declared budget "
+                f"{budget} — an extra static value or a dtype drift "
+                f"is minting programs the ladder never promised"))
+    return findings
+
+
+def measure_cache_sizes(fns: Dict[str, object]) -> Dict[str, int]:
+    """``{name: _cache_size()}`` for resolved budgeted jits."""
+    out = {}
+    for name, fn in fns.items():
+        if fn is not None and hasattr(fn, "_cache_size"):
+            out[name] = int(fn._cache_size())
+    return out
+
+
+def _budgeted_fns():
+    """Resolve the ENTRY_POINTS rows that declare budgets."""
+    import importlib
+
+    from ..obs.ledger import ENTRY_POINTS, entry_row
+    fns, budgets = {}, {}
+    for row in ENTRY_POINTS:
+        mod_name, attr, _donate, budget = entry_row(row)
+        if budget is None:
+            continue
+        kname = f"{mod_name.rsplit('.', 1)[-1]}.{attr}"
+        budgets[kname] = int(budget)
+        try:
+            mod = importlib.import_module(mod_name)
+            fn = getattr(mod, attr, None)
+        except Exception:
+            fn = None
+        if getattr(fn, "_ledger_wrapper", False):
+            # unwrap a live CostLedger wrapper, NOT the pjit itself
+            # (a pjit's __wrapped__ is the raw python fn, which has
+            # no cache)
+            fn = fn.__wrapped__
+        fns[kname] = fn
+    return fns, budgets
+
+
+def canonical_budget_sweep() -> Dict[str, int]:
+    """Drive every declared ladder shape and the natural engine legs,
+    from CLEARED jit caches, and return measured cache sizes.
+
+    The grid is the closure of what the burst loops can reach at the
+    canonical geometry (2048 nodes, 512-row batch, 128 floor):
+
+    * compact widths ``512 -> 256 -> 128`` (= log2(L/floor)+1 = 3
+      rungs of the PR-4 row ladder);
+    * merge rungs ``None, 16, 32`` (= log2(alpha)+1 = 3 rungs of the
+      PR-14 response-width ladder at alpha=4, 2K=16);
+    * the lifecycle overlay on/off for the undonated step (the serve
+      engine's admission plane rides it).
+
+    Engine legs (plain/traced compact+full, lifecycle) run FIRST so a
+    drift that mints an off-grid specialization (dtype drift, a new
+    implicit static) is counted against the same budget.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import swarm as sw
+    from ..ops.xor_metric import merge_ladder_widths
+    from ..parallel import make_mesh
+    from ..parallel import sharded as sh
+    from ..utils.hostdevice import dev_i32
+
+    fns, _budgets = _budgeted_fns()
+    for fn in fns.values():
+        if fn is not None and hasattr(fn, "clear_cache"):
+            fn.clear_cache()
+
+    cfg = sw.SwarmConfig.for_nodes(2048)
+    swarm = sw.build_swarm(jax.random.PRNGKey(7), cfg)
+    targets = jax.random.bits(jax.random.PRNGKey(1), (512, 5),
+                              jnp.uint32)
+    key = jax.random.PRNGKey(2)
+    resp_w = cfg.alpha * 2 * cfg.bucket_k
+    rungs = [None] + [w for w in
+                      merge_ladder_widths(resp_w, 2 * cfg.bucket_k)
+                      if w < resp_w]
+    widths = [512, 256, 128]
+
+    # -- natural engine legs (any off-grid compile counts against the
+    # budget): plain compact + full width, lifecycle, traced.
+    sw.lookup(swarm, cfg, targets, key, compact=True)
+    sw.lookup(swarm, cfg, targets, key, compact=False)
+    sw.lookup(swarm, cfg, targets, key, compact=True, stats={},
+              track_lifecycle=True)
+    sw.traced_lookup(swarm, cfg, targets, key, compact=True)
+
+    # -- the declared grid, driven directly with the SAME call
+    # spellings the engines and the ledger use (pjit's cache keys on
+    # the call-signature treedef too, so an equivalent call spelled
+    # differently is a distinct specialization — and a distinct
+    # compile wall).  Ladder engagement in the engine legs is
+    # convergence-dependent; the grid compiles every reachable rung.
+    def fresh(width):
+        t = targets[:width]
+        o = sw._sample_origins(key, swarm.alive, width)
+        return sw.lookup_init(swarm, cfg, t, o)
+
+    # lookup_step (budget 7): engine plain (positional-None rnd) +
+    # ledger/bench rung spellings (merge_w kw incl. None) + engine
+    # lifecycle (positional rnd) + its rungs.
+    sw.lookup_step(swarm, cfg, fresh(512), None)
+    for mw in (None, *[r for r in rungs if r is not None]):
+        sw.lookup_step(swarm, cfg, fresh(512), merge_w=mw)
+    sw.lookup_step(swarm, cfg, sw.init_lifecycle(fresh(512)),
+                   dev_i32(0))
+    for mw in rungs:
+        if mw is not None:
+            sw.lookup_step(swarm, cfg, sw.init_lifecycle(fresh(512)),
+                           dev_i32(0), merge_w=mw)
+    # donated/traced steps: widths x rungs x {plain, lifecycle} in the
+    # burst loops' exact spelling
+    for w in widths:
+        for mw in rungs:
+            sw._lookup_step_d(swarm, cfg, fresh(w), None, merge_w=mw)
+            sw._lookup_step_d(swarm, cfg,
+                              sw.init_lifecycle(fresh(w)),
+                              dev_i32(0), merge_w=mw)
+            tr = sw.empty_lookup_trace(cfg)
+            sw._traced_lookup_step_d(swarm, cfg, fresh(w), tr,
+                                     dev_i32(0), 512 - w, merge_w=mw)
+    # compaction plumbing at the ladder widths below full, plain +
+    # lifecycle state planes
+    for lifecycle in (False, True):
+        def fresh512():
+            st = fresh(512)
+            return sw.init_lifecycle(st) if lifecycle else st
+        for w in (256, 128):
+            order = jnp.arange(512, dtype=jnp.int32)
+            full, order2, sub = sw._compact_slice(fresh512(), order, w)
+            sw._writeback_prefix(full, sub)
+        full_b, order_c, sub_b = sw._compact_slice(
+            fresh512(), jnp.arange(512, dtype=jnp.int32), 256)
+        sw._compact_resize(full_b, order_c, sub_b, 128)
+
+    # -- routed engine + rungs on the 8-device mesh
+    if len(jax.devices()) >= 8:
+        mesh = make_mesh(8)
+        cfg8 = sw.SwarmConfig.for_nodes(8192)
+        sw8 = sw.build_swarm(jax.random.PRNGKey(0), cfg8)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (2048, 5),
+                             jnp.uint32)
+        sh.sharded_lookup(sw8, cfg8, tg, key, mesh, 2.0, compact=True)
+        resp_w8 = cfg8.alpha * 2 * cfg8.bucket_k
+        rungs8 = [None] + [w for w in
+                           merge_ladder_widths(resp_w8,
+                                               2 * cfg8.bucket_k)
+                           if w < resp_w8]
+        for mw in rungs8:
+            st8 = sh._sharded_lookup_init(sw8, cfg8, tg, key, mesh,
+                                          2.0)
+            sh._sharded_lookup_step(sw8, cfg8, st8, mesh, 2.0,
+                                    merge_w=mw)
+    return measure_cache_sizes(fns)
+
+
+def run_plane_budget(root: str) -> Tuple[List[Finding], dict]:
+    """Specialization-budget plane: canonical sweep + contract check.
+    Returns (findings, budget-table for the summary line)."""
+    from . import graftlint as gl
+
+    gl._setup_jax()
+    _fns, budgets = _budgeted_fns()
+    if not budgets:
+        return [], {}
+    measured = canonical_budget_sweep()
+    ep_line = 1
+    try:
+        ledger_file = os.path.join(root, LEDGER_PATH)
+        with open(ledger_file, encoding="utf-8") as f:
+            import ast as _ast
+            for node in _ast.parse(f.read()).body:
+                targets = node.targets if isinstance(
+                    node, _ast.Assign) else []
+                if any(isinstance(t, _ast.Name) and
+                       t.id == "ENTRY_POINTS" for t in targets):
+                    ep_line = node.lineno
+    except Exception:
+        pass
+    findings = check_budgets(measured, budgets, ep_line=ep_line)
+    table = {name: {"budget": budgets[name],
+                    "measured": measured.get(name)}
+             for name in sorted(budgets)}
+    return findings, table

@@ -91,3 +91,20 @@ def test_bootstrap_gives_up_and_releases_ops(monkeypatch):
         assert not r._bootstrapping
     finally:
         r.join()
+
+
+def test_run_failure_releases_claim():
+    # Post-review regression: a failed build (port already bound) must
+    # release the running claim — the old early-claim path left
+    # _running stuck True and every later run() returned silently.
+    a = DhtRunner()
+    a.run(port=0, bind4="127.0.0.1")
+    busy = a.get_bound_port()
+    b = DhtRunner()
+    with pytest.raises(OSError):
+        b.run(port=busy, bind4="127.0.0.1")
+    assert not b.is_running()
+    b.run(port=0, bind4="127.0.0.1")     # recovers on a free port
+    assert b.is_running()
+    a.join()
+    b.join()

@@ -606,3 +606,39 @@ class TestStoreTraceExtension:
         s = z + z
         assert int(jax.device_get(s.integrity_rejects)) == 0
         assert "integrity_rejects" in z.to_dict()
+
+
+class TestSignatureStageDrainRaces:
+    def test_double_drain_returns_conserving_stats(self):
+        # Post-review regression: a second drain() must wait for the
+        # worker like the first (joining a finished thread is a
+        # no-op) and report the SAME conserving stats, never an
+        # early snapshot missing an in-flight batch.
+        from opendht_tpu.models.integrity import SignatureStage
+        st = SignatureStage()
+        st.submit([object(), object()])
+        d1 = st.drain()
+        d2 = st.drain()
+        assert d1 == d2
+        assert d1["submitted"] == 2 and d1["batches"] == 1
+        if st.available:
+            assert d1["verified"] + d1["failed"] == d1["submitted"]
+
+    def test_concurrent_drains_agree(self):
+        import threading
+
+        from opendht_tpu.models.integrity import SignatureStage
+        st = SignatureStage()
+        for _ in range(4):
+            st.submit([object()] * 3)
+        outs = []
+        ts = [threading.Thread(target=lambda: outs.append(st.drain()))
+              for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert len(outs) == 4
+        assert all(o == outs[0] for o in outs)
+        assert outs[0]["submitted"] == 12
+        if st.available:
+            assert (outs[0]["verified"] + outs[0]["failed"]
+                    == outs[0]["submitted"])

@@ -103,7 +103,8 @@ class TestPureObserver:
     def test_entry_points_restored_after_context(self):
         import importlib
         before = {}
-        for mod_name, attr, _ in ENTRY_POINTS:
+        for row in ENTRY_POINTS:
+            mod_name, attr = row[0], row[1]
             mod = importlib.import_module(mod_name)
             before[(mod_name, attr)] = getattr(mod, attr, None)
         with CostLedger().instrument():
@@ -144,11 +145,15 @@ class TestKernelPlane:
         """Every registered entry point must still exist — a rename in
         models/parallel silently un-instruments the ledger otherwise."""
         import importlib
-        for mod_name, attr, donate in ENTRY_POINTS:
+        from opendht_tpu.obs.ledger import entry_row
+        for row in ENTRY_POINTS:
+            mod_name, attr, donate, budget = entry_row(row)
             mod = importlib.import_module(mod_name)
             fn = getattr(mod, attr, None)
             assert callable(fn), f"{mod_name}.{attr} vanished"
             assert isinstance(donate, tuple)
+            assert budget is None or (isinstance(budget, int)
+                                      and budget > 0)
 
     def test_records_walls_donation_costs(self, churned, targets):
         led = CostLedger()
