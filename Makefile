@@ -214,6 +214,22 @@ gate: lint test
 	python bench.py --mode auth --nodes 16384 --puts 2048 --repeat 3 --auth-out /tmp/auth.json
 	python -m opendht_tpu.tools.check_trace /tmp/auth.json
 	python -m opendht_tpu.tools.check_bench /tmp/auth.json BENCH_GATE_r13.json
+# The CHUNKED leg (round 20): multi-part values on the 8-device
+# sharded engine under injected chunk faults — per-part drop masks,
+# a mid-announce kill, a higher-seq torn overwrite, and a single
+# bit-flipped part at a fresh seq.  check_trace proves the artifact's
+# whole-value StoreTrace conservation (summed per-part requests and
+# accepts against the whole-value lookup oracle), the torn fail-safe
+# (every torn value reads MISSING — zero garbled bytes on any leg of
+# either arm), that the defended arm rejected every forged part at
+# the get-merge root check (integrity exactly 1.0, root_rejects
+# covering every affected row) with the undefended arm visibly
+# degraded, and that churn+heal republish sweeps restored every torn
+# value; check_bench re-gates the quality fields against the recorded
+# BENCH_GATE_r16.json row.
+	env XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu python bench.py --mode chunked --nodes 8192 --puts 64 --chunked-out /tmp/chunked.json
+	python -m opendht_tpu.tools.check_trace /tmp/chunked.json
+	python -m opendht_tpu.tools.check_bench /tmp/chunked.json BENCH_GATE_r16.json
 
 # Profiling workflow (README "Profiling"): the gate-config cost ledger
 # with its roofline verdict, plus the small republish-sweep profile —

@@ -118,7 +118,8 @@ def main():
                     choices=("lookups", "putget", "churn", "crawl",
                              "sharded", "hotshard", "repub", "chaos",
                              "chaos-lookup", "repub-profile", "serve",
-                             "monitor", "index", "soak", "auth"),
+                             "monitor", "index", "soak", "auth",
+                             "chunked"),
                     default="lookups")
     ap.add_argument("--kill-frac", type=float, default=None,
                     help="fraction of nodes killed (churn/chaos: 0.5; "
@@ -319,14 +320,17 @@ def main():
                          "validated by tools/check_trace.py, gated by "
                          "tools/check_bench.py")
     ap.add_argument("--mix", choices=("read-heavy", "write-heavy",
-                                      "scan-heavy"),
+                                      "scan-heavy", "chunk-heavy"),
                     default="read-heavy",
                     help="soak mode: scenario mix preset — the "
-                         "write/scan fractions of the arrival stream "
-                         "(read-heavy: 5%% writes; write-heavy: 50%% "
-                         "writes; scan-heavy: 5%% writes + 20%% index "
-                         "range scans); --write-frac/--scan-frac "
-                         "override the preset")
+                         "write/scan/chunk fractions of the arrival "
+                         "stream (read-heavy: 5%% writes; "
+                         "write-heavy: 50%% writes; scan-heavy: 5%% "
+                         "writes + 20%% index range scans; "
+                         "chunk-heavy: 5%% writes + 20%% chunked-"
+                         "value station ops); --write-frac/"
+                         "--scan-frac/--chunk-frac override the "
+                         "preset")
     ap.add_argument("--write-frac", type=float, default=None,
                     help="soak mode: fraction of arrivals that are "
                          "writes (announce with bumped seq), "
@@ -383,6 +387,47 @@ def main():
                          "conservation per work class, interference "
                          "ledger, monitor + republish blocks, SLO "
                          "gauges) as JSON — validated by "
+                         "tools/check_trace.py, gated by "
+                         "tools/check_bench.py")
+    ap.add_argument("--chunk-parts", type=int, default=4,
+                    help="chunked mode: parts per value (each a "
+                         "--payload-words slot row; the chaos legs "
+                         "need >= 2 so a write can tear between "
+                         "parts); soak mode: parts per chunk-station "
+                         "value when --chunk-frac > 0")
+    ap.add_argument("--chunk-fault-drop-frac", type=float,
+                    default=0.25,
+                    help="chunked mode: fraction of values whose part "
+                         "0 is dropped at announce (the torn_drop "
+                         "leg's part_drop_mask); must be in (0, 1] — "
+                         "a leg that tears nothing gates nothing")
+    ap.add_argument("--chunk-fault-kill-part", type=int, default=None,
+                    help="chunked mode: the part index the mid-"
+                         "announce kill strikes at (parts >= this "
+                         "never leave the NIC; default parts/2, must "
+                         "be in [1, parts))")
+    ap.add_argument("--chunk-fault-forge-part", type=int, default=1,
+                    help="chunked mode: the part whose first word the "
+                         "forge leg bit-flips (must be in [0, parts))")
+    ap.add_argument("--chunk-frac", type=float, default=None,
+                    help="soak mode: fraction of arrivals that are "
+                         "chunked-value station ops (reads + "
+                         "same-bytes refresh writes of multi-part "
+                         "values through the routed-twin store), "
+                         "overriding --mix; write + scan + chunk "
+                         "must stay <= 1")
+    ap.add_argument("--chunk-write-frac", type=float, default=0.25,
+                    help="soak mode: fraction of chunk-station ops "
+                         "that are seq-bump refresh WRITES (the rest "
+                         "are byte-exact reads); must be in [0, 1]")
+    ap.add_argument("--chunked-out", metavar="FILE", default=None,
+                    help="chunked mode: dump the chunk-fault artifact "
+                         "(kind swarm_chunked_trace: per-leg part-"
+                         "summed StoreTrace conservation vs the "
+                         "whole-value oracle, defended-vs-undefended "
+                         "integrity curve, torn-reads-as-missing "
+                         "rate, get-merge root rejections, republish-"
+                         "heal sweeps) as JSON — validated by "
                          "tools/check_trace.py, gated by "
                          "tools/check_bench.py")
     ap.add_argument("--auth-out", metavar="FILE", default=None,
@@ -514,21 +559,26 @@ def main():
         # stream: presets resolve first, explicit flags override, and
         # anything outside [0, 1] (or a mix that sums past 1) fails
         # HERE instead of as a nonsense schedule in the artifact.
-        preset = {"read-heavy": (0.05, 0.0),
-                  "write-heavy": (0.50, 0.0),
-                  "scan-heavy": (0.05, 0.20)}[args.mix]
+        preset = {"read-heavy": (0.05, 0.0, 0.0),
+                  "write-heavy": (0.50, 0.0, 0.0),
+                  "scan-heavy": (0.05, 0.20, 0.0),
+                  "chunk-heavy": (0.05, 0.0, 0.20)}[args.mix]
         if args.write_frac is None:
             args.write_frac = preset[0]
         if args.scan_frac is None:
             args.scan_frac = preset[1]
-        for nm in ("write_frac", "scan_frac"):
+        if args.chunk_frac is None:
+            args.chunk_frac = preset[2]
+        for nm in ("write_frac", "scan_frac", "chunk_frac",
+                   "chunk_write_frac"):
             v = getattr(args, nm)
             if not 0.0 <= v <= 1.0:
                 ap.error(f"--{nm.replace('_', '-')} must be a "
                          f"fraction in [0, 1], got {v}")
-        if args.write_frac + args.scan_frac > 1.0:
+        if args.write_frac + args.scan_frac + args.chunk_frac > 1.0:
             ap.error(f"scenario mix over-full: write {args.write_frac}"
-                     f" + scan {args.scan_frac} > 1")
+                     f" + scan {args.scan_frac} + chunk "
+                     f"{args.chunk_frac} > 1")
         if args.soak_interval <= 0:
             ap.error(f"--soak-interval must be > 0 s, got "
                      f"{args.soak_interval}")
@@ -570,10 +620,38 @@ def main():
                      f"got {args.auth_overhead_budget}")
         if not args.payload_words:
             args.payload_words = 8     # content-addressing needs bytes
+    if args.mode in ("chunked", "soak"):
+        # Chunk knobs are part indices and probabilities: reject
+        # nonsense at the CLI boundary, mirroring the --mix rule — a
+        # fault schedule that tears nothing (or tears out of range)
+        # gates nothing and lies in the artifact record.
+        if not 2 <= args.chunk_parts <= 16:
+            ap.error(f"--chunk-parts must be in [2, 16] (a chunk "
+                     f"fault needs a part boundary to tear at), got "
+                     f"{args.chunk_parts}")
+    if args.mode == "chunked":
+        if not 0.0 < args.chunk_fault_drop_frac <= 1.0:
+            ap.error(f"--chunk-fault-drop-frac must be in (0, 1], "
+                     f"got {args.chunk_fault_drop_frac}")
+        if args.chunk_fault_kill_part is None:
+            args.chunk_fault_kill_part = max(1, args.chunk_parts // 2)
+        if not 1 <= args.chunk_fault_kill_part < args.chunk_parts:
+            ap.error(f"--chunk-fault-kill-part must be in [1, "
+                     f"{args.chunk_parts}) — killing before part 0 "
+                     f"announces nothing, at or past the last part "
+                     f"tears nothing, got "
+                     f"{args.chunk_fault_kill_part}")
+        if not 0 <= args.chunk_fault_forge_part < args.chunk_parts:
+            ap.error(f"--chunk-fault-forge-part must be in [0, "
+                     f"{args.chunk_parts}), got "
+                     f"{args.chunk_fault_forge_part}")
+        if not args.payload_words:
+            args.payload_words = 2     # parts are W-word slot rows
     if args.kill_frac is None:
         args.kill_frac = {"chaos-lookup": 0.10,
                           "monitor": 0.05,
                           "auth": 0.10,
+                          "chunked": 0.10,
                           "soak": 0.02}.get(args.mode, 0.5)
     if args.nodes is None:
         args.nodes = {"churn": 100_000, "sharded": 1_000_000,
@@ -584,6 +662,7 @@ def main():
                       "serve": 65_536,
                       "soak": 65_536,
                       "auth": 65_536,
+                      "chunked": 8_192,
                       "monitor": 1_000_000,
                       "index": 1_000_000,
                       "chaos-lookup": 1_000_000}.get(args.mode,
@@ -597,6 +676,8 @@ def main():
                  "lookups mode (drop --compact off)")
     if args.mode == "auth":
         return auth_main(args)
+    if args.mode == "chunked":
+        return chunked_main(args)
     if args.mode == "soak":
         return soak_main(args)
     if args.mode == "monitor":
@@ -2450,8 +2531,12 @@ def soak_main(args):
     kw["merge_impl"] = args.merge_impl
     cfg = SwarmConfig.for_nodes(args.nodes, **kw)
     store_slots = args.slots or 4
+    # Chunked-station values live in the SAME soak store, so mixing
+    # chunk ops in (--chunk-frac) arms payload rows store-wide; the
+    # token-only store stays the default shape.
+    pw = args.payload_words or (2 if args.chunk_frac > 0 else 0)
     scfg = StoreConfig(slots=store_slots, listen_slots=4,
-                       max_listeners=1 << 10, payload_words=0)
+                       max_listeners=1 << 10, payload_words=pw)
     p = min(args.puts, args.nodes * store_slots // 16)
     put_keys = jax.random.bits(jax.random.PRNGKey(11), (p, 5),
                                jnp.uint32)
@@ -2460,7 +2545,8 @@ def soak_main(args):
         rate=args.arrival_rate, duration=args.duration,
         key_pool=args.key_pool, zipf_s=zipf_s, seed=7,
         write_frac=args.write_frac, scan_frac=args.scan_frac,
-        scan_span=args.scan_span)
+        scan_span=args.scan_span, chunk_frac=args.chunk_frac,
+        chunk_write_frac=args.chunk_write_frac)
     mcfg = MonitorConfig.for_nodes(
         args.nodes, period=args.monitor_period,
         fresh_ttl=args.fresh_ttl,
@@ -2538,12 +2624,19 @@ def soak_main(args):
             # node joining the swarm); the soak's interleaved sweeps
             # then start at the steady-state incremental width.
             mon.sweep(jax.random.PRNGKey(400))
+        station = None
+        if args.chunk_frac > 0:
+            from opendht_tpu.models.serve import ChunkedStation
+            station = ChunkedStation(cfg, scfg,
+                                     parts=args.chunk_parts,
+                                     pool=32, batch=16, seed=5)
         soak = SoakEngine(swarm, cfg, slots=args.serve_slots,
                           scfg=scfg, store=store, monitor=mon,
                           index=index, scan_key_fn=scan_key_fn,
                           soak_cfg=soak_cfg,
                           maint_key=jax.random.PRNGKey(0x50AC),
-                          cache_slots=args.serve_cache)
+                          cache_slots=args.serve_cache,
+                          chunk_station=station)
         return soak, rep0
 
     def survival(soak_arm):
@@ -2658,6 +2751,9 @@ def soak_main(args):
         "value_survival_off_arm": survival_off,
         "scan_completed": rep["scan"]["completed"],
         "scan_latency_mean_s": rep["scan"]["latency_mean_s"],
+        "chunk_frac": args.chunk_frac,
+        "chunk_completed": rep["chunked"]["completed"],
+        "chunk_garbled": rep["chunked"]["garbled"],
         "cache_slots": rep["cache_slots"],
         "cache_hits": rep["cache_hits"],
         "cache_misses": rep["cache_misses"],
@@ -2689,6 +2785,7 @@ def soak_main(args):
                 "never_admitted": rep["never_admitted"],
                 "wclass_mismatches": rep["wclass_mismatches"],
                 "scan": rep["scan"],
+                "chunked": rep["chunked"],
                 "cache_slots": rep["cache_slots"],
                 "cache_hits": rep["cache_hits"],
                 "cache_misses": rep["cache_misses"],
@@ -3043,6 +3140,325 @@ def auth_main(args):
             f.write("\n")
     print(json.dumps(out))
     if not ok:
+        sys.exit(1)
+
+
+def chunked_main(args):
+    """Chunk-fault chaos plane on the sharded engine (ISSUE 16): do
+    chunked values survive the mesh?
+
+    A pool of ``--puts`` variable-size values (``--chunk-parts`` parts
+    of ``--payload-words`` words each, hash-list content-addressed
+    keys, ONE zero-length row) is driven through the routed
+    announce/get twins at infinite capacity — the chaos is INJECTED,
+    never ambient — in five legs per arm:
+
+    * **clean** — exact reassembly, with the summed per-part StoreTrace
+      equated to the whole-value oracle (a second identically-seeded
+      routed lookup: every active part on every found node);
+    * **torn_drop** — ``--chunk-fault-drop-frac`` of the values lose
+      part 0 at announce (``part_drop_mask``);
+    * **kill_mid** — the writer dies between parts: only parts below
+      ``--chunk-fault-kill-part`` leave the NIC (``part_range``);
+    * **torn_overwrite** — a full publish at seq 1, then a seq-2
+      overwrite killed after part 0: the reassembly guard must refuse
+      to mix generations;
+    * **forge** — every part re-announced at seq 3 with ONE word of
+      part ``--chunk-fault-forge-part`` bit-flipped; the DEFENDED arm
+      (``StoreConfig.verify``) rejects affected rows at the get-merge
+      (``_chunked_root_ok`` in-jit, ``root_rejects`` = guard-passing
+      hits minus root-passing hits on the SAME store), the undefended
+      arm serves garbled bytes — the defended-vs-undefended curve.
+
+    The mesh-wide contract is MISSING, NEVER GARBLED: every torn row
+    reads back missing in BOTH arms (``torn_missing_rate`` exactly
+    1.0), and the defended arm serves zero garbled rows anywhere.
+    A final heal leg churns ``--kill-frac`` of the swarm (+heal),
+    then counts owner republish sweeps until every value — including
+    the torn ones — reads back whole.  The artifact
+    (``--chunked-out``, kind ``swarm_chunked_trace``) is validated by
+    ``tools/check_trace.py check_chunked_obj`` and gated by
+    ``tools/check_bench.py``; the bench self-validates through the
+    same checker and exits 1 on any violation — these are correctness
+    statements, not measurements.
+    """
+    from opendht_tpu.models.chunked_values import (
+        chunked_content_ids, chunked_content_ids_host,
+        mask_chunk_payloads,
+    )
+    from opendht_tpu.models.storage import StoreConfig
+    from opendht_tpu.models.swarm import (
+        SwarmConfig, build_swarm, churn, heal_swarm,
+    )
+    from opendht_tpu.parallel import make_mesh
+    from opendht_tpu.parallel.sharded import sharded_lookup
+    from opendht_tpu.parallel.sharded_storage import (
+        sharded_announce_chunked, sharded_empty_store,
+        sharded_get_chunked,
+    )
+    from opendht_tpu.tools.check_trace import check_chunked_obj
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev)
+    kw = {} if args.aug == "auto" else {"aug_tables": args.aug == "on"}
+    kw["merge_impl"] = args.merge_impl
+    cfg = SwarmConfig.for_nodes(args.nodes, **kw)
+    swarm = build_swarm(jax.random.PRNGKey(0), cfg)
+    _ = np.asarray(swarm.tables[:1, :1])
+
+    parts = args.chunk_parts
+    w = args.payload_words
+    cap = float("inf")
+    base = dict(slots=args.slots or 16, listen_slots=2,
+                max_listeners=1 << 6, payload_words=w)
+    scfg_v = StoreConfig(verify=True, **base)
+    scfg_u = StoreConfig(verify=False, **base)
+    p = max(4, min(args.puts,
+                   cfg.n_nodes * scfg_v.slots // (16 * parts)))
+
+    rng = np.random.default_rng(16)
+    pls_h = rng.integers(0, 1 << 32, (p, parts, w),
+                         dtype=np.uint64).astype(np.uint32)
+    lens_h = rng.integers(1, 4 * parts * w + 1,
+                          (p,)).astype(np.uint32)
+    # Pinned rows: ONE zero-length value (all zero-length values share
+    # one content key — a second would collide), one sub-word, one
+    # spanning every part (so every torn leg provably bites).
+    lens_h[0] = 0
+    if p > 1:
+        lens_h[1] = 3
+    lens_h[2:4] = 4 * parts * w
+    payloads = jnp.asarray(pls_h)
+    lengths = jnp.asarray(lens_h)
+    keys = chunked_content_ids(payloads, lengths)
+    vals = jnp.arange(p, dtype=jnp.uint32) + 1
+    seqs = jnp.ones((p,), jnp.uint32)
+    masked, _ml = mask_chunk_payloads(payloads, lengths)
+    oracle = np.asarray(masked).reshape(p, parts * w)
+    words = (lens_h.astype(np.int64) + 3) // 4
+    n_parts_of = np.clip(-(-words // w), 1, parts)
+    digest_parity = bool(
+        (np.asarray(keys)
+         == chunked_content_ids_host(pls_h, lens_h)).all())
+
+    # Fault plans, shared verbatim across arms (host-side, so every
+    # `affected` count below is exact, not sampled).
+    kp = args.chunk_fault_kill_part
+    fp = args.chunk_fault_forge_part
+    tdrop = np.asarray(
+        rng.random(p) < args.chunk_fault_drop_frac)
+    if not tdrop.any():
+        tdrop[2] = True                  # the full-span pinned row
+    drop_mask = np.zeros((p, parts), bool)
+    drop_mask[:, 0] = tdrop              # part 0 lost => whole value
+    drop_mask_j = jnp.asarray(drop_mask)
+    a_drop = int(tdrop.sum())
+    kill_rows = n_parts_of > kp          # parts >= kp never sent
+    a_kill = int(kill_rows.sum())
+    torn_rows = n_parts_of > 1           # seq-2 overwrite died after 0
+    a_torn = int(torn_rows.sum())
+    forge_rows = words > fp * w          # the flipped word is LIVE
+    a_forge = int(forge_rows.sum())
+    forged_h = pls_h.copy()
+    forged_h[:, fp, 0] ^= 0x80000000
+    forged = jnp.asarray(forged_h)
+
+    def measure(res):
+        hit = np.asarray(res.hit)
+        exact_rows = hit & (np.asarray(res.length) == lens_h) \
+            & (np.asarray(res.payload) == oracle).all(axis=1)
+        h, e = int(hit.sum()), int(exact_rows.sum())
+        return hit, {"hit": h, "missing": p - h, "garbled": h - e,
+                     "exact": e}
+
+    def tsum(*trs):
+        return {k: sum(t[k] for t in trs) for k in trs[0]}
+
+    def run_arm(scfg):
+        """One arm: five legs, each on a FRESH store, same PRNGKeys as
+        the other arm (identical routing — the arms differ only in the
+        read-side verify)."""
+        legs, hits = {}, {}
+
+        def fresh():
+            return sharded_empty_store(cfg.n_nodes, scfg, mesh)
+
+        def put(store, seed, pls=payloads, sq=seqs, now=0, **faults):
+            return sharded_announce_chunked(
+                swarm, cfg, store, scfg, keys, vals, sq, now,
+                jax.random.PRNGKey(seed), mesh, pls, lengths,
+                capacity_factor=cap, **faults)
+
+        def get(store, seed, sc=None):
+            return sharded_get_chunked(
+                swarm, cfg, store, sc or scfg, keys,
+                jax.random.PRNGKey(seed), mesh, parts,
+                capacity_factor=cap)
+
+        # clean
+        store, rep = put(fresh(), 100)
+        tr_clean = rep.trace.to_dict()
+        hit, m = measure(get(store, 101))
+        legs["clean"] = dict(m, affected=0, trace=tr_clean)
+        hits["clean"] = hit
+        # torn_drop (keep the store — it seeds the heal leg)
+        store_drop, rep = put(fresh(), 110,
+                              part_drop_mask=drop_mask_j)
+        hit, m = measure(get(store_drop, 111))
+        legs["torn_drop"] = dict(m, affected=a_drop,
+                                 trace=rep.trace.to_dict())
+        hits["torn_drop"] = hit
+        # kill_mid
+        store, rep = put(fresh(), 120, part_range=(0, kp))
+        hit, m = measure(get(store, 121))
+        legs["kill_mid"] = dict(m, affected=a_kill,
+                                trace=rep.trace.to_dict())
+        hits["kill_mid"] = hit
+        # torn_overwrite
+        store, rep1 = put(fresh(), 130)
+        store, rep2 = put(store, 131, sq=seqs + 1, now=1,
+                          part_range=(0, 1))
+        hit, m = measure(get(store, 132))
+        legs["torn_overwrite"] = dict(
+            m, affected=a_torn, trace=tsum(rep1.trace.to_dict(),
+                                           rep2.trace.to_dict()))
+        hits["torn_overwrite"] = hit
+        # forge
+        store, rep1 = put(fresh(), 140)
+        store, rep2 = put(store, 141, pls=forged, sq=seqs + 2, now=2)
+        res = get(store, 142)
+        hit, m = measure(res)
+        legs["forge"] = dict(m, affected=a_forge,
+                             trace=tsum(rep1.trace.to_dict(),
+                                        rep2.trace.to_dict()))
+        hits["forge"] = hit
+        if scfg.verify:
+            # root_rejects = rows that pass the reassembly guard but
+            # fail the hash-list root — measured on the SAME store,
+            # same routing seed, verify off vs on.
+            guard_hit = np.asarray(get(store, 142, sc=scfg_u).hit)
+            legs["forge"]["root_rejects"] = \
+                int(guard_hit.sum()) - m["hit"]
+        h_tot = sum(lg["hit"] for lg in legs.values())
+        e_tot = sum(lg["exact"] for lg in legs.values())
+        integrity = 1.0 if h_tot == 0 else e_tot / h_tot
+        return {"integrity": integrity, "legs": legs}, hits, store_drop
+
+    defended, hits_d, store_drop = run_arm(scfg_v)
+    undefended, hits_u, _ = run_arm(scfg_u)
+
+    # Whole-value conservation oracle for the clean leg: the same
+    # seeded routed lookup yields the same found set; every value
+    # places each ACTIVE part (words > j*W, part 0 always) on every
+    # found node — at infinite capacity on an empty store that is
+    # exactly the summed requests, every one a fresh accept.
+    res_o = sharded_lookup(swarm, cfg, keys, jax.random.PRNGKey(100),
+                           mesh, cap)
+    found_per_row = (np.asarray(res_o.found) >= 0).sum(axis=1)
+    oracle_req = sum(
+        int(found_per_row[(words > j * w) | (j == 0)].sum())
+        for j in range(parts))
+    tr_clean = defended["legs"]["clean"]["trace"]
+    conservation = {"requests": tr_clean["requests"],
+                    "oracle_requests": oracle_req,
+                    "accepts_new": tr_clean["accepts_new"],
+                    "oracle_accepts_new": oracle_req}
+
+    # Torn rows must read MISSING in both arms — rate over every
+    # affected row of every torn leg.
+    torn_n = torn_miss = 0
+    for leg, rows in (("torn_drop", tdrop), ("kill_mid", kill_rows),
+                      ("torn_overwrite", torn_rows)):
+        for hits in (hits_d, hits_u):
+            torn_n += int(rows.sum())
+            torn_miss += int((~hits[leg][rows]).sum())
+    torn_missing_rate = torn_miss / torn_n if torn_n else 1.0
+
+    # Heal: the torn_drop store under churn (+healed routing), owner
+    # republish sweeps until every value reads back whole.
+    sw_heal = swarm
+    if args.kill_frac:
+        sw_heal = churn(swarm._replace(tables=jnp.copy(swarm.tables)),
+                        jax.random.PRNGKey(150), args.kill_frac, cfg)
+        sw_heal = heal_swarm(sw_heal, cfg, jax.random.PRNGKey(151))
+    _hit, m = measure(sharded_get_chunked(
+        sw_heal, cfg, store_drop, scfg_v, keys,
+        jax.random.PRNGKey(152), mesh, parts, capacity_factor=cap))
+    pre_hit = m["hit"]
+    sweeps = 0
+    for s in range(1, 9):
+        store_drop, _rep = sharded_announce_chunked(
+            sw_heal, cfg, store_drop, scfg_v, keys, vals, seqs,
+            10 + s, jax.random.PRNGKey(160 + s), mesh, payloads,
+            lengths, capacity_factor=cap)
+        _hit, m = measure(sharded_get_chunked(
+            sw_heal, cfg, store_drop, scfg_v, keys,
+            jax.random.PRNGKey(170 + s), mesh, parts,
+            capacity_factor=cap))
+        sweeps = s
+        if m["hit"] == p:
+            break
+    heal = {"pre_hit": pre_hit, "post_hit": m["hit"],
+            "sweeps": sweeps, "post_garbled": m["garbled"]}
+
+    d_int = defended["integrity"]
+    u_int = undefended["integrity"]
+    g_total = sum(lg["garbled"]
+                  for lg in defended["legs"].values())
+    out = {
+        "metric": "swarm_chunked_defended_integrity",
+        "value": d_int,
+        "unit": "frac",
+        "vs_baseline": round(d_int - u_int, 4),
+        "baseline_note": "vs_baseline = defended - undefended "
+                         "integrity under the same chunk-fault "
+                         "injection (the get-merge hash-list "
+                         "defense's recall gain, auth mode's "
+                         "convention)",
+        "n_nodes": args.nodes,
+        "n_devices": n_dev,
+        "values": p,
+        "parts": parts,
+        "payload_words": w,
+        "kill_frac": args.kill_frac,
+        "chunk_fault_drop_frac": args.chunk_fault_drop_frac,
+        "chunk_fault_kill_part": kp,
+        "chunk_fault_forge_part": fp,
+        "digest_parity": digest_parity,
+        "undefended_integrity": u_int,
+        "garbled_reads": g_total,
+        "undefended_garbled_reads": sum(
+            lg["garbled"] for lg in undefended["legs"].values()),
+        "torn_missing_rate": torn_missing_rate,
+        "torn_affected": a_drop + a_kill + a_torn,
+        "forge_affected": a_forge,
+        "root_rejects": defended["legs"]["forge"]["root_rejects"],
+        "heal_pre_hit": pre_hit,
+        "heal_sweeps": sweeps,
+        "platform": jax.devices()[0].platform,
+    }
+    obj = {
+        "kind": "swarm_chunked_trace",
+        "bench": out,
+        "params": {"values": p, "parts": parts, "payload_words": w,
+                   "nodes": args.nodes},
+        "digest_parity": digest_parity,
+        "conservation": conservation,
+        "arms": {"defended": defended, "undefended": undefended},
+        "heal": heal,
+    }
+    # Self-validate through the gate's own checker: reassembly
+    # exactness and missing-never-garbled are correctness statements —
+    # a bench that fails them must exit 1 even with no --chunked-out.
+    errs = check_chunked_obj(obj)
+    for e in errs:
+        print(f"bench: chunked {e}", file=sys.stderr)
+    if args.chunked_out:
+        with open(args.chunked_out, "w") as f:
+            json.dump(obj, f)
+            f.write("\n")
+    print(json.dumps(out))
+    if errs:
         sys.exit(1)
 
 

@@ -26,21 +26,53 @@ across ``ceil(words/W)`` slots of the SAME replica:
 This removes the "one fixed payload width per store" fidelity
 asterisk: per-value lengths are real, bytes are real, reassembly is
 exact.
+
+Integrity plane (``scfg.verify``) — hash-list content addressing.
+The flat store's plane recomputes ``SHA-1(payload) == key`` per slot,
+which cannot hold for part keys (``pk_j = key XOR j`` is derived from
+the base key, not from part j's bytes).  Chunked values instead use
+the reference's hash-list shape: the base key is the digest of the
+PER-PART digests plus the true length,
+
+    ``key = SHA-1( SHA-1(part_0) ‖ … ‖ SHA-1(part_{parts-1}) ‖ len )``
+
+over the CANONICAL payload form (:func:`mask_chunk_payloads`: inactive
+parts and words past the value end zeroed).  Writers mint keys with
+:func:`chunked_content_ids` (host twin
+:func:`chunked_content_ids_host`); part inserts and probes run with
+the per-slot digest check OFF (``scfg._replace(verify=False)`` — the
+exact unverified programs), and the defense moves to the READ MERGE:
+:func:`_chunked_root_ok` recomputes the root in-jit from the
+reassembled parts, so one forged or corrupted part flips the root and
+the value reads as MISSING — same fail-safe as a torn write, never a
+garbled byte.  The threat model is thus availability-loss only: an
+attacker who can announce a higher-seq part can suppress a value (as
+any torn write does) but can never make a reader ACCEPT bytes that do
+not hash to the key, and the length under the root stops a forged
+part 0 from lying about the value size.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+import hashlib
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ..ops.sha1 import sha1_words
 from .storage import (
     AnnounceReport,
     StoreConfig,
     SwarmStore,
     _announce_insert,
     _get_probe,
+    _listen_insert,
+    ack_listeners,
+    cancel_listen,
+    dev_u32,
 )
 from .swarm import Swarm, SwarmConfig, lookup
 
@@ -53,6 +85,94 @@ class ChunkedGetResult(NamedTuple):
     payload: jax.Array  # [P, parts*W] uint32 — reassembled words
     hops: jax.Array     # [P]
     done: jax.Array     # [P]
+
+
+class ChunkedCollectResult(NamedTuple):
+    """One collected listener delivery — the value-LIST push of the
+    reference's ``tellListener`` reassembled from per-part slots."""
+    ready: jax.Array    # [P] bool — a complete value was delivered
+    val: jax.Array      # [P] uint32
+    seq: jax.Array      # [P] uint32
+    length: jax.Array   # [P] uint32 — true byte length
+    payload: jax.Array  # [P, parts*W] uint32
+
+
+def mask_chunk_payloads(payloads: jax.Array, lengths: jax.Array
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Canonical chunk form: clamp ``lengths [P]`` to what
+    ``payloads [P, parts, W]`` can represent and zero every word at or
+    past each value's end (inactive parts zero entirely).  The root id
+    is defined over THIS form, so storage padding past the value end
+    can never affect a digest."""
+    p, parts, w = payloads.shape
+    lengths = jnp.minimum(jnp.asarray(lengths).astype(jnp.uint32),
+                          jnp.uint32(parts * w * 4))
+    words = -(-lengths.astype(jnp.int32) // 4)               # [P]
+    idx = jnp.arange(parts * w, dtype=jnp.int32).reshape(parts, w)
+    masked = jnp.where(idx[None] < words[:, None, None],
+                       payloads.astype(jnp.uint32), 0)
+    return masked, lengths
+
+
+def _root_ids(payloads: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Hash-list root of chunked values (traced body shared by the
+    writer-side mint and the reader-side check): per-part SHA-1 digests
+    over the canonical form, then SHA-1 over the digest list plus the
+    true byte length."""
+    p, parts, w = payloads.shape
+    masked, lengths = mask_chunk_payloads(payloads, lengths)
+    digests = sha1_words(masked)                             # [P,parts,5]
+    msg = jnp.concatenate(
+        [digests.reshape(p, parts * 5), lengths[:, None]], axis=1)
+    return sha1_words(msg)
+
+
+@jax.jit
+def chunked_content_ids(payloads: jax.Array,
+                        lengths: jax.Array) -> jax.Array:
+    """Content-addressed base keys for chunked values:
+    ``key = SHA-1(SHA-1(part_0) ‖ … ‖ SHA-1(part_{parts-1}) ‖ len)``
+    over ``payloads [P, parts, W]`` / ``lengths [P]`` — the chunked
+    twin of :func:`opendht_tpu.models.integrity.content_ids` (hash-list
+    shape, because a reader must be able to re-derive the key from the
+    reassembled parts).  Returns ``[P, 5]`` uint32 digest limbs."""
+    return _root_ids(payloads, lengths)
+
+
+@jax.jit
+def _chunked_root_ok(keys: jax.Array, payloads: jax.Array,
+                     lengths: jax.Array) -> jax.Array:
+    """Reader-side integrity check, in-jit at the get merge: does the
+    reassembled value hash back to its claimed base key?  One forged or
+    corrupted part flips its digest, the digest flips the root, and the
+    row reads as missing — never as garbled bytes."""
+    return jnp.all(_root_ids(payloads, lengths) == keys, axis=-1)
+
+
+def chunked_content_ids_host(payloads, lengths) -> np.ndarray:
+    """Bit-identical hashlib twin of :func:`chunked_content_ids` for
+    ``[P, parts, W]`` uint32 payloads (parity pinned in tests — host
+    and device views of one chunked id must be interchangeable, like
+    :func:`~opendht_tpu.models.integrity.content_ids_host`)."""
+    pl = np.ascontiguousarray(np.asarray(payloads, np.uint32))
+    if pl.ndim == 2:
+        pl = pl[None]
+    p, parts, w = pl.shape
+    lengths = np.minimum(
+        np.asarray(lengths, np.uint32).reshape(p),
+        np.uint32(parts * w * 4))
+    words = -(-lengths.astype(np.int64) // 4)
+    idx = np.arange(parts * w).reshape(parts, w)
+    masked = np.where(idx[None] < words[:, None, None], pl,
+                      0).astype(">u4")
+    out = np.zeros((p, 5), np.uint32)
+    for i in range(p):
+        msg = b"".join(hashlib.sha1(masked[i, j].tobytes()).digest()
+                       for j in range(parts))
+        msg += np.array([lengths[i]], dtype=">u4").tobytes()
+        d = hashlib.sha1(msg).digest()
+        out[i] = np.frombuffer(d, dtype=">u4").astype(np.uint32)
+    return out
 
 
 def part_key(keys: jax.Array, j: int) -> jax.Array:
@@ -89,11 +209,18 @@ def announce_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     p, parts, w = payloads.shape
     assert w == scfg.payload_words, (w, scfg.payload_words)
     res = lookup(swarm, cfg, keys, rng)
-    # Clamp to what ``payloads`` can actually represent: an oversize
-    # recorded length would store unreadable-forever parts (the reader
-    # rejects need_words > parts·w), silently wasting replica budget.
-    lengths = jnp.minimum(lengths, jnp.uint32(parts * w * 4))
+    # Canonical form: clamp lengths to what ``payloads`` can actually
+    # represent (an oversize recorded length would store unreadable-
+    # forever parts — the reader rejects need_words > parts·w) and zero
+    # padding past the value end, so the stored bytes ARE the form the
+    # hash-list root is defined over.
+    payloads, lengths = mask_chunk_payloads(payloads, lengths)
     words = -(-lengths.astype(jnp.int32) // 4)               # [P]
+    # Part keys are key-derived, not content-derived, so the per-slot
+    # digest check can never pass on them: parts always insert through
+    # the UNVERIFIED programs and integrity moves to the read merge
+    # (see module docstring) — same compiled insert either way.
+    part_scfg = scfg._replace(verify=False)
     rep0, trace = None, None
     for j in range(parts):
         # Part 0 is active unconditionally (it carries the value's
@@ -103,8 +230,9 @@ def announce_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
         sizes_j = (lengths.astype(jnp.uint32) if j == 0
                    else jnp.ones_like(lengths, jnp.uint32))
         store, rep, tr = _announce_insert(
-            swarm.alive, cfg, store, scfg, found_j, part_key(keys, j),
-            vals, seqs, jnp.uint32(now), sizes_j, None, payloads[:, j])
+            swarm.alive, cfg, store, part_scfg, found_j,
+            part_key(keys, j), vals, seqs, jnp.uint32(now), sizes_j,
+            None, payloads[:, j])
         trace = tr if trace is None else trace + tr
         if j == 0:
             rep0 = rep
@@ -121,11 +249,16 @@ def get_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     A value is ``hit`` iff part 0 is found and every part the recorded
     length requires is found with part-0's ``(val, seq)`` — a torn or
     partially-expired value reads as missing, never as garbled bytes.
+    With ``scfg.verify`` the reassembled value must also hash back to
+    its base key (:func:`_chunked_root_ok`): a forged or corrupted
+    part downgrades the row to missing, same fail-safe shape.
     """
+    p = keys.shape[0]
     w = scfg.payload_words
+    part_scfg = scfg._replace(verify=False)   # see announce_chunked
     res = lookup(swarm, cfg, keys, rng)
-    h0, val, seq, pl0, sz = _get_probe(swarm.alive, cfg, store, scfg,
-                                       res.found, keys)
+    h0, val, seq, pl0, sz = _get_probe(swarm.alive, cfg, store,
+                                       part_scfg, res.found, keys)
     need_words = -(-sz.astype(jnp.int32) // 4)               # [P]
     n_parts = jnp.clip(-(-need_words // max(w, 1)), 1, parts)
     # A value longer than the caller's ``parts`` budget must read as
@@ -134,18 +267,133 @@ def get_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     ok = h0 & (need_words <= parts * w)
     pls = [pl0]
     for j in range(1, parts):
-        hj, vj, sj, plj, _ = _get_probe(swarm.alive, cfg, store, scfg,
-                                        res.found, part_key(keys, j))
+        hj, vj, sj, plj, _ = _get_probe(swarm.alive, cfg, store,
+                                        part_scfg, res.found,
+                                        part_key(keys, j))
         needed = n_parts > j
         ok = ok & (~needed | (hj & (vj == val) & (sj == seq)))
         pls.append(jnp.where(needed[:, None], plj, 0))
     payload = jnp.concatenate(pls, axis=1)                   # [P,parts*W]
-    # Zero everything past the true length (a part slot's tail words
-    # beyond the value end are storage padding, not value bytes).
+    # Canonicalize (zero words past the true length — a part slot's
+    # tail words beyond the value end are storage padding, not value
+    # bytes), check the root over the canonical form, THEN zero rows
+    # that failed either the reassembly guard or the root.
     idx = jnp.arange(parts * w, dtype=jnp.int32)[None, :]
-    payload = jnp.where((idx < need_words[:, None]) & ok[:, None],
-                        payload, 0)
+    payload = jnp.where(idx < need_words[:, None], payload, 0)
+    if scfg.verify:
+        ok = ok & _chunked_root_ok(keys, payload.reshape(p, parts, w),
+                                   sz.astype(jnp.uint32))
+    payload = jnp.where(ok[:, None], payload, 0)
     return ChunkedGetResult(
         hit=ok, val=jnp.where(ok, val, 0), seq=jnp.where(ok, seq, 0),
         length=jnp.where(ok, sz, 0), payload=payload,
         hops=res.hops, done=res.done)
+
+
+# ---------------------------------------------------------------------------
+# chunked listeners — value-LIST delivery (ref tellListener semantics)
+# ---------------------------------------------------------------------------
+
+def chunked_reg_ids(reg_ids: jax.Array, parts: int) -> jax.Array:
+    """Dense per-part registration-id block of a chunked listener:
+    logical id ``r`` owns delivery slots ``r·parts … r·parts+parts-1``
+    (part ``j`` delivers into slot ``r·parts + j``).  Callers keep
+    ``r·parts + parts ≤ scfg.max_listeners``; invalid ids stay
+    negative and are dropped by the table insert.  Returns the
+    flattened ``[P·parts]`` int32 id vector (ack/cancel sweeps take it
+    directly)."""
+    rid = jnp.asarray(reg_ids, jnp.int32)
+    block = rid[:, None] * parts + jnp.arange(parts, dtype=jnp.int32)
+    return jnp.where(rid[:, None] >= 0, block, -1).reshape(-1)
+
+
+def listen_chunked(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
+                   scfg: StoreConfig, keys: jax.Array,
+                   reg_ids: jax.Array, rng: jax.Array, parts: int,
+                   now=0) -> Tuple[SwarmStore, jax.Array]:
+    """Register chunked listeners: ONE lookup per base key, then ONE
+    listener-table insert covering every part key, so every part's
+    future announces deliver into the logical listener's per-part
+    slots (:func:`chunked_reg_ids`).
+
+    All parts ride a SINGLE insert batch on purpose: a node accepts at
+    most ``listen_slots`` rows per batch in sorted-key order, and one
+    key's part keys sort adjacent, so a node either holds a chunked
+    registration WHOLE or not at all — per-part calls would instead
+    wrap the ring slot-by-slot and tear every co-located registration
+    (keys sharing a neighborhood share their entire quorum).  A node
+    needs ``listen_slots ≥ parts`` to hold one chunked registration;
+    keys co-located beyond ``listen_slots // parts`` fall back to the
+    quorum nodes they do not share.  Returns ``(store, done [P])``."""
+    res = lookup(swarm, cfg, keys, rng)
+    rid = jnp.asarray(reg_ids, jnp.int32)
+    found_b = jnp.tile(res.found, (parts, 1))
+    keys_b = jnp.concatenate([part_key(keys, j) for j in range(parts)])
+    rid_b = jnp.concatenate([jnp.where(rid >= 0, rid * parts + j, -1)
+                             for j in range(parts)])
+    store = _listen_insert(swarm.alive, cfg, store, scfg, found_b,
+                           keys_b, rid_b, dev_u32(now))
+    return store, res.done
+
+
+@partial(jax.jit, static_argnames=("scfg", "parts"))
+def collect_chunked(store: SwarmStore, scfg: StoreConfig,
+                    reg_ids: jax.Array, parts: int,
+                    keys: Optional[jax.Array] = None
+                    ) -> ChunkedCollectResult:
+    """Reassemble delivered chunked values from listener slots — the
+    reference pushes the changed VALUE LIST to a listener
+    (``tellListener``, src/network_engine.cpp:161-173); here the list
+    is the per-part delivery slots, merged under the same guard as
+    :func:`get_chunked`: ready iff part 0 delivered and every needed
+    part was delivered with part-0's ``(val, seq)``.  A torn delivery
+    (some parts' announces lost) is NOT ready — never garbled.  With
+    ``scfg.verify`` and the base ``keys [P,5]`` given, the reassembled
+    value must also hash back to its key (:func:`_chunked_root_ok`).
+    Pair with :func:`ack_chunked` to consume and re-arm."""
+    w = scfg.payload_words
+    ml = scfg.max_listeners
+    rid = jnp.asarray(reg_ids, jnp.int32)
+    p = rid.shape[0]
+    slot0 = rid * parts
+    valid = (rid >= 0) & (slot0 + parts <= ml)
+    s0 = jnp.clip(slot0, 0, ml - 1)
+    nseq0 = store.nseqs[s0]                  # delivered seq + 1, 0=none
+    val0 = store.nvals[s0]
+    sz = store.nsizes[s0]
+    need_words = -(-sz.astype(jnp.int32) // 4)
+    n_parts = jnp.clip(-(-need_words // max(w, 1)), 1, parts)
+    ready = valid & (nseq0 > 0) & (need_words <= parts * w)
+    pls = [store.npayload[s0]]
+    for j in range(1, parts):
+        sj = jnp.clip(slot0 + j, 0, ml - 1)
+        needed = n_parts > j
+        same = (store.nseqs[sj] == nseq0) & (store.nvals[sj] == val0)
+        ready = ready & (~needed | same)
+        pls.append(jnp.where(needed[:, None], store.npayload[sj], 0))
+    payload = jnp.concatenate(pls, axis=1)
+    idx = jnp.arange(parts * w, dtype=jnp.int32)[None, :]
+    payload = jnp.where(idx < need_words[:, None], payload, 0)
+    if scfg.verify and keys is not None:
+        ready = ready & _chunked_root_ok(
+            keys, payload.reshape(p, parts, w), sz)
+    payload = jnp.where(ready[:, None], payload, 0)
+    # nseqs stores delivered_seq+1 saturated at 0xFFFFFFFE+1.
+    return ChunkedCollectResult(
+        ready=ready, val=jnp.where(ready, val0, 0),
+        seq=jnp.where(ready, nseq0 - 1, 0),
+        length=jnp.where(ready, sz, 0), payload=payload)
+
+
+def ack_chunked(store: SwarmStore, reg_ids: jax.Array,
+                parts: int) -> SwarmStore:
+    """Consume the delivery slots of whole chunked listeners (all
+    parts at once) so the next accepted announce re-delivers."""
+    return ack_listeners(store, chunked_reg_ids(reg_ids, parts))
+
+
+def cancel_chunked(store: SwarmStore, scfg: StoreConfig,
+                   reg_ids: jax.Array, parts: int) -> SwarmStore:
+    """Cancel whole chunked listeners mesh-wide: every part's table
+    rows die and the per-part delivery slots clear."""
+    return cancel_listen(store, scfg, chunked_reg_ids(reg_ids, parts))

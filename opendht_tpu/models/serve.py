@@ -1262,3 +1262,115 @@ def closed_loop_replay(swarm: Swarm, cfg: SwarmConfig,
     res = LookupResult(found=_finalize(swarm.ids, st, cfg),
                        hops=st.hops, done=st.done)
     return res, st
+
+
+# ---------------------------------------------------------------------------
+# chunked-value request station (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+class ChunkedStation:
+    """Host-side station serving CHUNKED-value requests as a first-
+    class class of the serve/soak plane.
+
+    Holds a pool of content-addressed multi-part values (random bytes;
+    row 0 is the zero-length edge — exactly one, because every zero-
+    length value shares ONE content key).  Chunked READS reassemble
+    through :func:`~opendht_tpu.models.chunked_values.get_chunked` and
+    are byte-checked against the host oracle: a hit is either exact or
+    books as ``garbled`` — the contract-violation counter the soak
+    checker pins at 0 (missing is the only legal degradation).
+    Chunked WRITES are same-bytes seq-bump refreshes: the key IS the
+    content, so the only in-place write is a republish-style refresh
+    (mutating the bytes would mint a different key, i.e. a new value).
+
+    Batches pad to a fixed ``batch`` width so the station drives
+    exactly one compiled program per phase (both warmed pre-clock by
+    the soak loop); padding rows re-read/re-announce pool row 0 at its
+    CURRENT seq with its own bytes, so the store content cannot
+    change and results on padding rows are discarded.
+    """
+
+    def __init__(self, cfg: SwarmConfig, scfg, parts: int,
+                 pool: int = 32, batch: int = 16, seed: int = 0):
+        from .chunked_values import (
+            chunked_content_ids, mask_chunk_payloads,
+        )
+        if parts < 1:
+            raise ValueError(f"parts must be >= 1, got {parts}")
+        if pool < 1 or batch < 1:
+            raise ValueError(f"pool/batch must be >= 1, got "
+                             f"pool={pool} batch={batch}")
+        self.cfg, self.scfg = cfg, scfg
+        self.parts, self.pool, self.batch = parts, pool, batch
+        w = scfg.payload_words
+        rng = np.random.default_rng(seed ^ 0xC4)
+        pls = rng.integers(0, 2 ** 32, (pool, parts, w),
+                           dtype=np.uint64).astype(np.uint32)
+        lens = rng.integers(1, parts * w * 4 + 1, (pool,),
+                            dtype=np.int64).astype(np.uint32)
+        lens[0] = 0
+        self.payloads = jnp.asarray(pls)
+        self.lengths = jnp.asarray(lens)
+        self.keys = chunked_content_ids(self.payloads, self.lengths)
+        masked, _ = mask_chunk_payloads(self.payloads, self.lengths)
+        self.oracle = np.asarray(masked).reshape(pool, parts * w)
+        self.oracle_len = np.asarray(lens)
+        self.vals = jnp.arange(1, pool + 1, dtype=jnp.uint32)
+        self.seqs = np.full((pool,), 2, np.uint64)  # host seq ledger
+        self.reads = self.writes = 0
+        self.garbled = self.missing = 0
+
+    def announce_pool(self, swarm, store, key, now):
+        """Initial full-pool announce (the values chunked requests
+        will read); returns the donated store."""
+        from .chunked_values import announce_chunked
+        store, _rep = announce_chunked(
+            swarm, self.cfg, store, self.scfg, self.keys, self.vals,
+            jnp.asarray(self.seqs.astype(np.uint32)), now, key,
+            self.payloads, self.lengths)
+        return store
+
+    def _pad(self, ranks):
+        ranks = np.asarray(ranks, np.int64) % self.pool
+        n = len(ranks)
+        if n > self.batch:
+            raise ValueError(f"batch of {n} exceeds the compiled "
+                             f"station width {self.batch}")
+        out = np.zeros((self.batch,), np.int64)
+        out[:n] = ranks
+        return jnp.asarray(out), n
+
+    def read(self, swarm, store, ranks, key):
+        """Serve one padded batch of chunked reads; books hits /
+        garbled / missing over the REAL rows and returns
+        ``(hits, garbled)``."""
+        from .chunked_values import get_chunked
+        idx, n = self._pad(ranks)
+        res = get_chunked(swarm, self.cfg, store, self.scfg,
+                          self.keys[idx], key, self.parts)
+        rows = np.asarray(idx)[:n]
+        hit = np.asarray(res.hit)[:n]
+        ok = hit \
+            & (np.asarray(res.length)[:n] == self.oracle_len[rows]) \
+            & np.all(np.asarray(res.payload)[:n]
+                     == self.oracle[rows], axis=1)
+        garbled = int((hit & ~ok).sum())
+        self.reads += n
+        self.garbled += garbled
+        self.missing += int(n - hit.sum())
+        return int(hit.sum()), garbled
+
+    def refresh(self, swarm, store, ranks, key, now):
+        """Serve one padded batch of chunked writes (same-bytes
+        seq-bump refreshes); returns the donated store."""
+        from .chunked_values import announce_chunked
+        idx, n = self._pad(ranks)
+        rows = np.asarray(idx)
+        self.seqs[rows[:n]] += 1
+        store, _rep = announce_chunked(
+            swarm, self.cfg, store, self.scfg, self.keys[idx],
+            self.vals[idx],
+            jnp.asarray(self.seqs[rows].astype(np.uint32)), now, key,
+            self.payloads[idx], self.lengths[idx])
+        self.writes += n
+        return store

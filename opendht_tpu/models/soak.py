@@ -285,7 +285,11 @@ class SoakConfig(NamedTuple):
       ``_announce_insert`` flush (also that program's compiled width);
     * ``scan_batch`` / ``scan_max_wait_s`` — scan-station batching:
       flush when this many scans are pending or the oldest has waited
-      this long.
+      this long;
+    * ``chunk_max_wait_s`` — chunked-station batching deadline: the
+      station flushes when its compiled batch width fills (the
+      ``ChunkedStation.batch`` knob) or the oldest pending chunked
+      request has waited this long.
     """
     interval_s: float = 0.5
     repub_period_s: float = 1.0
@@ -296,6 +300,7 @@ class SoakConfig(NamedTuple):
     write_flush: int = 256
     scan_batch: int = 16
     scan_max_wait_s: float = 0.25
+    chunk_max_wait_s: float = 0.25
 
 
 class _Sweep:
@@ -365,7 +370,8 @@ class SoakEngine:
                  admit_cap: int | None = None,
                  soak_cfg: SoakConfig | None = None,
                  maint_key: jax.Array | None = None,
-                 cache_slots: int = 0):
+                 cache_slots: int = 0,
+                 chunk_station=None):
         self.swarm, self.cfg = swarm, cfg
         # ``cache_slots`` arms the serve engine's hot-key result cache
         # AND the soak loop's probe-fused admission
@@ -383,6 +389,10 @@ class SoakEngine:
         self.mon = monitor
         self.index = index
         self.scan_key_fn = scan_key_fn
+        # A ``models.serve.ChunkedStation`` arms the chunked request
+        # class ("chunk"/"chunkw" ops): multi-part values served
+        # through the chunked engine against this engine's store.
+        self.chunk = chunk_station
         self.soak_cfg = soak_cfg or SoakConfig()
         self.maint_key = (maint_key if maint_key is not None
                           else jax.random.PRNGKey(0x50AC))
@@ -691,32 +701,47 @@ class SoakEngine:
 def mixed_events(rate: float, duration: float, key_pool: int,
                  zipf_s: float, seed: int = 0, hot_frac: float = 0.01,
                  write_frac: float = 0.0, scan_frac: float = 0.0,
-                 scan_span: int = 64):
+                 scan_span: int = 64, chunk_frac: float = 0.0,
+                 chunk_write_frac: float = 0.25):
     """The soak arrival schedule: :func:`poisson_zipf_events` plus an
-    op class per request (read / write / scan) and rank windows for
-    the scans.
+    op class per request (read / write / scan / chunk) and rank
+    windows for the scans.
 
     Returns ``(arrival_ts [R], keys [R,5], klass [R] hot/cold,
-    ops [R] read/write/scan, scan_lo [R], scan_hi [R])``.  Scan
-    windows ride the same Zipf popularity as the keys (hot ranks get
-    scanned more — the arXiv:1009.3681 read-heavy shape); rows whose
-    op is not ``scan`` carry unused windows.
+    ops [R] read/write/scan/chunk/chunkw, scan_lo [R], scan_hi [R])``.
+    Scan windows ride the same Zipf popularity as the keys (hot ranks
+    get scanned more — the arXiv:1009.3681 read-heavy shape); rows
+    whose op is not ``scan`` carry unused windows.  ``chunk_frac`` of
+    requests are CHUNKED (multi-part value) ops, of which
+    ``chunk_write_frac`` are writes (same-bytes seq-bump refreshes —
+    ``"chunkw"``) and the rest reassembling reads (``"chunk"``); the
+    chunked station maps the Zipf draw in ``scan_lo`` onto its value
+    pool.
     """
     if not 0.0 <= write_frac <= 1.0 or not 0.0 <= scan_frac <= 1.0 \
-            or write_frac + scan_frac > 1.0:
+            or not 0.0 <= chunk_frac <= 1.0 \
+            or write_frac + scan_frac + chunk_frac > 1.0:
         raise ValueError(
             f"scenario-mix fractions must be in [0, 1] with "
-            f"write + scan <= 1, got write={write_frac} "
-            f"scan={scan_frac}")
+            f"write + scan + chunk <= 1, got write={write_frac} "
+            f"scan={scan_frac} chunk={chunk_frac}")
+    if not 0.0 <= chunk_write_frac <= 1.0:
+        raise ValueError(f"chunk_write_frac must be in [0, 1], got "
+                         f"{chunk_write_frac}")
     ts, keys, klass, draw = poisson_zipf_events(
         rate, duration, key_pool, zipf_s, seed=seed,
         hot_frac=hot_frac, return_draw=True)
     r = len(ts)
     rng = np.random.default_rng(seed ^ 0x50AC)
     u = rng.random(r)
-    ops = np.where(u < scan_frac, "scan",
-                   np.where(u < scan_frac + write_frac, "write",
-                            "read"))
+    cw = scan_frac + chunk_frac * chunk_write_frac
+    cr = scan_frac + chunk_frac
+    ops = np.where(
+        u < scan_frac, "scan",
+        np.where(u < cw, "chunkw",
+                 np.where(u < cr, "chunk",
+                          np.where(u < cr + write_frac, "write",
+                                   "read"))))
     scan_lo = np.minimum(draw, key_pool - 1).astype(np.int64)
     scan_hi = np.minimum(scan_lo + scan_span - 1, key_pool - 1)
     return ts, keys, klass, ops, scan_lo, scan_hi
@@ -825,6 +850,11 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
                           or soak.scan_key_fn is None):
         raise ValueError("scan requests need an index + scan_key_fn "
                          "on the SoakEngine")
+    has_chunk = ("chunk" in ops) or ("chunkw" in ops)
+    if has_chunk and (soak.chunk is None or soak.store is None):
+        raise ValueError("chunked requests need a ChunkedStation + "
+                         "store (chunk_station/scfg/store on the "
+                         "SoakEngine)")
     drain_cap = drain_round_cap or 4 * cfg.max_steps
     if duration is None:
         duration = float(arrival_ts[-1]) if r_total else 0.0
@@ -840,8 +870,11 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
             f"slots reserves no whole slot — maintenance could never "
             f"admit a row; raise the fraction or the slot count")
     do_scan = soak.index is not None and "scan" in ops
+    do_chunk = soak.chunk is not None and has_chunk
     has_writes = "write" in ops
     n_scan_sched = int(np.sum(ops == "scan")) if do_scan else 0
+    n_chunk_sched = int(np.sum((ops == "chunk")
+                               | (ops == "chunkw"))) if do_chunk else 0
 
     # --- warm pass: the serve programs (identical set — bit-identity
     # depends on it), then the soak-only fixed-width programs.
@@ -870,6 +903,25 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
         pw = soak.index.spec.prefix_words
         soak.index.range_query(np.zeros((1, pw), np.uint32),
                                np.zeros((1, pw), np.uint32))
+    if do_chunk:
+        # Chunked-station warm, pre-clock: the pool announce seeds the
+        # values chunked requests serve, then one empty padded read
+        # and one empty padded refresh compile the station's two
+        # programs (fixed batch width) before the clock starts.
+        soak.store = soak.chunk.announce_pool(
+            soak.swarm, soak.store,
+            jax.random.fold_in(soak.maint_key, 0xC400),
+            soak.store_now)
+        soak.store_now += 1
+        soak.chunk.read(soak.swarm, soak.store, [],
+                        jax.random.fold_in(soak.maint_key, 0xC401))
+        soak.store = soak.chunk.refresh(
+            soak.swarm, soak.store, [],
+            jax.random.fold_in(soak.maint_key, 0xC402),
+            soak.store_now)
+        soak.store_now += 1
+        soak.chunk.reads = soak.chunk.writes = 0
+        soak.chunk.garbled = soak.chunk.missing = 0
     # Maintenance/scenario warm, all PRE-clock: the serve loop's
     # contract — compile must never masquerade as queueing delay —
     # applies doubly here, because an on-clock compile would book as
@@ -944,6 +996,12 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
     scan_done, scan_lat, scan_entries = 0, [], 0
     scan_flushes = 0
     scan_flush_wall = 0.0
+    # Chunked station.
+    chunk_queue: list[int] = []
+    chunk_done, chunk_lat = 0, []
+    chunk_reads_done = chunk_writes_done = 0
+    chunk_flushes = 0
+    chunk_flush_wall = 0.0
 
     def flush_writes(now_w):
         nonlocal wbuf, wpend, write_flushes, write_flush_wall
@@ -1002,6 +1060,46 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
         scan_flushes += 1
         scan_flush_wall += t1f - t0f
 
+    def flush_chunks():
+        nonlocal chunk_queue, chunk_done, chunk_flushes, \
+            chunk_flush_wall, chunk_reads_done, chunk_writes_done
+        cb = soak.chunk.batch
+        while chunk_queue:
+            take, chunk_queue = chunk_queue[:cb], chunk_queue[cb:]
+            t0f = clock()
+            kf = jax.random.fold_in(soak.maint_key,
+                                    0xC500 + chunk_flushes)
+            w_rows = [ri for ri in take if ops[ri] == "chunkw"]
+            r_rows = [ri for ri in take if ops[ri] != "chunkw"]
+            if w_rows:
+                # A chunked write is a same-bytes seq-bump refresh (a
+                # store insert): bump the result-cache epoch, exactly
+                # like the write flush.
+                soak.store = soak.chunk.refresh(
+                    soak.swarm, soak.store,
+                    [int(scan_lo[ri]) for ri in w_rows],
+                    jax.random.fold_in(kf, 1), soak.store_now)
+                soak.store_now += 1
+                soak.serve.invalidate_cache()
+            if r_rows:
+                soak.chunk.read(
+                    soak.swarm, soak.store,
+                    [int(scan_lo[ri]) for ri in r_rows],
+                    jax.random.fold_in(kf, 2))
+            t1f = clock()
+            for ri in take:
+                lat = max(0.0, (t1f - t0) - float(arrival_ts[ri]))
+                chunk_lat.append(lat)
+                if latency_plane is not None:
+                    latency_plane.observe(lat, op="chunk")
+                if timeline is not None:
+                    timeline.note_complete("chunk", lat, t1f - t0)
+            chunk_done += len(take)
+            chunk_reads_done += len(r_rows)
+            chunk_writes_done += len(w_rows)
+            chunk_flushes += 1
+            chunk_flush_wall += t1f - t0f
+
     t0 = clock()
     while True:
         now = clock() - t0
@@ -1020,6 +1118,10 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
                 scan_queue.append(next_ev)
                 if timeline is not None:
                     timeline.note_arrival("scan", now)
+            elif ops[next_ev] in ("chunk", "chunkw") and do_chunk:
+                chunk_queue.append(next_ev)
+                if timeline is not None:
+                    timeline.note_arrival("chunk", now)
             else:
                 queue.append(next_ev)
                 if timeline is not None:
@@ -1168,7 +1270,14 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
                 >= scfg_soak.scan_max_wait_s):
             flush_scans()
 
-        draining = sched_done and not scan_queue
+        # --- chunked station (batched, between bursts)
+        if do_chunk and chunk_queue and (
+                len(chunk_queue) >= soak.chunk.batch or sched_done
+                or now - float(arrival_ts[chunk_queue[0]])
+                >= scfg_soak.chunk_max_wait_s):
+            flush_chunks()
+
+        draining = sched_done and not scan_queue and not chunk_queue
         if draining and not occupied:
             break
         if not occupied and not queue:
@@ -1390,6 +1499,7 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
         in_flight_c[wcls] += 1
     serve_in_flight = sum(in_flight_c[x] for x in SERVE_CLASSES)
     scan_arrived = scan_done + len(scan_queue)
+    chunk_arrived = chunk_done + len(chunk_queue)
     return {
         "slots": c,
         "admit_cap": a_cap,
@@ -1399,10 +1509,11 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
         "expired": expired,
         "in_flight": serve_in_flight,
         # Slot-served never-admitted: queued + not-yet-arrived, minus
-        # the schedule's scan ops that the scan station owns.  With no
-        # scan station this is the serve loop's formula verbatim.
+        # the schedule's scan/chunked ops their stations own.  With
+        # no stations this is the serve loop's formula verbatim.
         "never_admitted": len(queue) + (r_total - next_ev)
-        - (n_scan_sched - scan_arrived),
+        - (n_scan_sched - scan_arrived)
+        - (n_chunk_sched - chunk_arrived),
         "rounds": rnd,
         "elapsed_s": elapsed,
         "sustained_rps": completed / elapsed if elapsed > 0 else 0.0,
@@ -1449,5 +1560,20 @@ def soak_open_loop(soak: SoakEngine, arrival_ts, keys, key,
             if scan_lat else None,
             "latency_max_s": round(float(np.max(scan_lat)), 6)
             if scan_lat else None,
+        },
+        "chunked": {
+            "arrived": chunk_arrived,
+            "completed": chunk_done,
+            "pending": len(chunk_queue),
+            "reads": chunk_reads_done,
+            "writes": chunk_writes_done,
+            "garbled": soak.chunk.garbled if do_chunk else 0,
+            "missing": soak.chunk.missing if do_chunk else 0,
+            "flushes": chunk_flushes,
+            "flush_wall_s": round(chunk_flush_wall, 6),
+            "latency_mean_s": round(float(np.mean(chunk_lat)), 6)
+            if chunk_lat else None,
+            "latency_max_s": round(float(np.max(chunk_lat)), 6)
+            if chunk_lat else None,
         },
     }

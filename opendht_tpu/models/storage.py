@@ -258,6 +258,10 @@ class SwarmStore(NamedTuple):
     nseqs: jax.Array     # [max_listeners] uint32 — delivered seq + 1
     nvals: jax.Array     # [max_listeners] uint32 — delivered value token
     npayload: jax.Array  # [max_listeners,W] uint32 — delivered bytes
+    # Delivered value SIZE: chunked listeners reassemble value LISTS
+    # from per-part delivery slots, and part 0's recorded size is the
+    # only way a collector recovers the true byte length.
+    nsizes: jax.Array    # [max_listeners] uint32 — delivered value size
 
 
 class StoreTrace(NamedTuple):
@@ -416,6 +420,7 @@ def empty_store(n_nodes: int, scfg: StoreConfig) -> SwarmStore:
         nvals=jnp.zeros((scfg.max_listeners,), jnp.uint32),
         npayload=jnp.zeros((scfg.max_listeners, scfg.payload_words),
                            jnp.uint32),
+        nsizes=jnp.zeros((scfg.max_listeners,), jnp.uint32),
     )
 
 
@@ -688,12 +693,13 @@ def _store_insert(store: SwarmStore, scfg: StoreConfig,
                              store.npayload)
     else:
         npayload = store.npayload
+    nsizes = jnp.where(deliver, s_size[r_safe], store.nsizes)
 
     new_store = store._replace(keys=keys, vals=vals, seqs=seqs,
                                created=created, used=used, cursor=cursor,
                                notified=notified, sizes=sizes, ttls=ttls,
                                payload=payload, nseqs=nseqs, nvals=nvals,
-                               npayload=npayload)
+                               npayload=npayload, nsizes=nsizes)
     # Per-put replica counts.
     put_safe = jnp.clip(s_put, 0, None)
     replicas = jnp.zeros((m,), jnp.int32).at[put_safe].add(
@@ -988,7 +994,8 @@ def cancel_listen(store: SwarmStore, scfg: StoreConfig,
         notified=store.notified & ~cancel,
         nseqs=jnp.where(cancel, 0, store.nseqs),
         nvals=jnp.where(cancel, 0, store.nvals),
-        npayload=jnp.where(cancel[:, None], 0, store.npayload))
+        npayload=jnp.where(cancel[:, None], 0, store.npayload),
+        nsizes=jnp.where(cancel, 0, store.nsizes))
 
 
 @jax.jit
@@ -1010,7 +1017,8 @@ def ack_listeners(store: SwarmStore, reg_ids: jax.Array) -> SwarmStore:
         notified=store.notified & ~ack,
         nseqs=jnp.where(ack, 0, store.nseqs),
         nvals=jnp.where(ack, 0, store.nvals),
-        npayload=jnp.where(ack[:, None], 0, store.npayload))
+        npayload=jnp.where(ack[:, None], 0, store.npayload),
+        nsizes=jnp.where(ack, 0, store.nsizes))
 
 
 @partial(jax.jit, static_argnames=("scfg",))

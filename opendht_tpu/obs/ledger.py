@@ -117,6 +117,8 @@ ENTRY_POINTS: tuple = (
     ("opendht_tpu.ops.sha1", "sha1_one_block", ()),
     ("opendht_tpu.ops.sha1", "sha1_blocks", ()),
     ("opendht_tpu.models.integrity", "content_ids", ()),
+    ("opendht_tpu.models.chunked_values", "chunked_content_ids", ()),
+    ("opendht_tpu.models.chunked_values", "_chunked_root_ok", ()),
     ("opendht_tpu.models.monitor", "fold_sweep", (0,)),
     ("opendht_tpu.parallel.sharded", "_sharded_lookup_while", ()),
     ("opendht_tpu.parallel.sharded", "_sharded_lookup_init", ()),

@@ -95,9 +95,9 @@ class SoakTimeline:
             "i": i,
             "t_start": round(i * self.interval_s, 6),
             "t_end": round((i + 1) * self.interval_s, 6),
-            "arrivals": dict(z, scan=0),
+            "arrivals": dict(z, scan=0, chunk=0),
             "admitted": dict(z),
-            "completed": dict(z, scan=0),
+            "completed": dict(z, scan=0, chunk=0),
             "expired": dict(z),
             "queue_samples": [],
             "bursts": 0,
@@ -108,6 +108,7 @@ class SoakTimeline:
             "latency_sum_s": 0.0,
             "slo_violations": 0,
             "scan_latency_sum_s": 0.0,
+            "chunk_latency_sum_s": 0.0,
             "maint_ops": 0,
             "maint_ops_wall_s": 0.0,
             "other_ops": 0,
@@ -154,6 +155,8 @@ class SoakTimeline:
         row["maint_ops_wall_s"] = round(row["maint_ops_wall_s"], 6)
         row["other_ops_wall_s"] = round(row["other_ops_wall_s"], 6)
         row["scan_latency_sum_s"] = round(row["scan_latency_sum_s"], 6)
+        row["chunk_latency_sum_s"] = round(
+            row["chunk_latency_sum_s"], 6)
         self.rows.append(row)
 
     # -- the note surface ---------------------------------------------
@@ -175,9 +178,13 @@ class SoakTimeline:
                       t: float) -> None:
         self._roll(t)
         self._cur["completed"][cls] += 1
-        if latency_s is None or cls == "scan":
+        if latency_s is None or cls in ("scan", "chunk"):
+            # Station-served classes (scans, chunked reads/writes)
+            # complete outside the slot plane at a different latency
+            # scale — summarized separately, never mixed into the
+            # serve histogram the interference ledger isolates.
             if latency_s is not None:
-                self._cur["scan_latency_sum_s"] += latency_s
+                self._cur[f"{cls}_latency_sum_s"] += latency_s
             return
         b = int(np.searchsorted(self.bounds, latency_s, side="left"))
         self._cur["latency_counts"][b] += 1

@@ -248,11 +248,15 @@ def _insert_routed(cfg: SwarmConfig, scfg: StoreConfig, n_shards: int,
     r_pl = (_i2u(rbuf[..., 5 + N_LIMBS:]).reshape(m, -1)
             if w and payloads is not None else None)
     # req_put = flat request index → _store_insert's replica vector
-    # becomes a per-request accept bit we can route back.
+    # becomes a per-request accept bit we can route back.  Sizes ride
+    # the wire VERBATIM (size 0 is a real recorded length — a
+    # zero-length chunked part 0 — and must read back as 0, exactly as
+    # on the local engine; invalid rows are dropped by their node
+    # index, never by size).
     store_local, acc, trace = _store_insert(
         store_local, scfg, r_node, r_key, r_val, r_seq,
         jnp.arange(m, dtype=jnp.int32), now,
-        jnp.maximum(r_size, 1), r_ttl, r_pl)
+        r_size, r_ttl, r_pl)
 
     back = _route_back(acc.reshape(n_shards, cap, 1), owner, pos, sent,
                        cap)
@@ -318,8 +322,11 @@ def _merge_listener_state(store_local: SwarmStore) -> SwarmStore:
         jnp.where(mine, store_local.nvals, 0), AXIS)
     npayload = jax.lax.pmax(
         jnp.where(mine[:, None], store_local.npayload, 0), AXIS)
+    nsizes = jax.lax.pmax(
+        jnp.where(mine, store_local.nsizes, 0), AXIS)
     return store_local._replace(notified=notified, nseqs=gseq,
-                                nvals=nvals, npayload=npayload)
+                                nvals=nvals, npayload=npayload,
+                                nsizes=nsizes)
 
 
 def _probe_phase_body(cfg: SwarmConfig, scfg: StoreConfig,
@@ -372,6 +379,10 @@ def _probe_phase_body(cfg: SwarmConfig, scfg: StoreConfig,
     is_w = is_b & (store_local.vals[n_safe] == val[:, None])  # [M,S]
     sslots = scfg.slots
     wslot = jnp.argmax(is_w, axis=1).astype(jnp.int32)
+    # The winner's recorded SIZE rides back with its bytes — a chunked
+    # part-0 probe needs the true byte length the local engine's
+    # ``_get_probe`` already returns.
+    szv = jnp.where(anyhit, store_local.sizes[n_safe, wslot], 0)
     if w:
         pl = jnp.where(anyhit[:, None],
                        _pl_gather(store_local.payload,
@@ -380,24 +391,28 @@ def _probe_phase_body(cfg: SwarmConfig, scfg: StoreConfig,
         pl = jnp.zeros((is_w.shape[0], 0), jnp.uint32)
 
     resp = jnp.concatenate(
-        [jnp.stack([anyhit.astype(jnp.int32), _u2i(val), _u2i(best)],
+        [jnp.stack([anyhit.astype(jnp.int32), _u2i(val), _u2i(best),
+                    _u2i(szv)],
                    axis=-1), _u2i(pl)],
-        axis=-1).reshape(n_shards, cap, 3 + w)
-    back = _route_back(resp, owner, pos, sent, cap)      # [Q,3+W]
+        axis=-1).reshape(n_shards, cap, 4 + w)
+    back = _route_back(resp, owner, pos, sent, cap)      # [Q,4+W]
     h = (back[:, 0] > 0).reshape(ll, quorum)
     v = _i2u(jnp.where(sent, back[:, 1], 0)).reshape(ll, quorum)
     s = _i2u(jnp.where(sent, back[:, 2], 0)).reshape(ll, quorum)
-    q_pl = _i2u(jnp.where(sent[:, None], back[:, 3:], 0)
-                ).reshape(ll, quorum, w)
+    q_szpl = _i2u(jnp.where(sent[:, None], back[:, 3:], 0)
+                  ).reshape(ll, quorum, 1 + w)
 
     s = jnp.where(h, s, 0)
     best_seq = jnp.max(s, axis=1)
     win = h & (s == best_seq[:, None])
     best_val = jnp.max(jnp.where(win, v, 0), axis=1)
-    # Single-replica pick across the quorum too (no word blending).
-    out_pl = _pick_payload(win & (v == best_val[:, None]), q_pl,
-                           jnp.any(h, axis=1))
-    return jnp.any(h, axis=1), best_val, best_seq, out_pl
+    # Single-replica pick across the quorum too (no word blending);
+    # the size column rides the same pick so size and bytes can never
+    # come from different replicas.
+    out = _pick_payload(win & (v == best_val[:, None]), q_szpl,
+                        jnp.any(h, axis=1))
+    return (jnp.any(h, axis=1), best_val, best_seq, out[:, 1:],
+            out[:, 0])
 
 
 def _store_specs(mesh: Mesh) -> SwarmStore:
@@ -410,7 +425,7 @@ def _store_specs(mesh: Mesh) -> SwarmStore:
         lkeys=P(AXIS), lids=P(AXIS), lexps=P(AXIS), lcursor=shd,
         notified=P(), sizes=P(AXIS, None), ttls=P(AXIS, None),
         payload=P(AXIS), nseqs=P(), nvals=P(),
-        npayload=P(None, None))
+        npayload=P(None, None), nsizes=P())
 
 
 def shard_store(store: SwarmStore, mesh: Mesh) -> SwarmStore:
@@ -514,7 +529,7 @@ def _sharded_probe_phase(swarm: Swarm, cfg: SwarmConfig,
                 capacity_factor),
         mesh=mesh,
         in_specs=(P(), specs, P(AXIS, None), P(AXIS, None)),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS, None)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS, None), P(AXIS)),
         check_vma=False)
     return fn(swarm.alive, store, found, keys)
 
@@ -525,9 +540,9 @@ def sharded_get(swarm: Swarm, cfg: SwarmConfig, store: SwarmStore,
     """Batched get over the sharded swarm + store (freshest-seq wins).
     Same two-phase shape as :func:`sharded_announce`."""
     res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
-    hit, val, seq, pl = _sharded_probe_phase(swarm, cfg, store, scfg,
-                                             res.found, keys, mesh,
-                                             capacity_factor)
+    hit, val, seq, pl, _sz = _sharded_probe_phase(swarm, cfg, store,
+                                                  scfg, res.found, keys,
+                                                  mesh, capacity_factor)
     return GetResult(hit=hit, val=val, seq=seq, hops=res.hops,
                      done=res.done, payload=pl)
 
@@ -773,3 +788,182 @@ def sharded_ack_listeners(store: SwarmStore,
     accepted announce re-delivers (see
     :func:`opendht_tpu.models.storage.ack_listeners`)."""
     return ack_listeners(store, reg_ids)
+
+
+# ---------------------------------------------------------------------------
+# chunked values on the mesh (variable-size multi-part values)
+# ---------------------------------------------------------------------------
+
+from ..models.chunked_values import (  # noqa: E402
+    ChunkedGetResult,
+    _chunked_root_ok,
+    ack_chunked,
+    collect_chunked,
+    cancel_chunked,
+    mask_chunk_payloads,
+    part_key,
+)
+
+
+def sharded_announce_chunked(swarm: Swarm, cfg: SwarmConfig,
+                             store: SwarmStore, scfg: StoreConfig,
+                             keys: jax.Array, vals: jax.Array,
+                             seqs: jax.Array, now, key: jax.Array,
+                             mesh: Mesh, payloads: jax.Array,
+                             lengths: jax.Array,
+                             capacity_factor: float = 4.0,
+                             drop_frac: float = 0.0,
+                             drop_key: jax.Array | None = None,
+                             part_drop_mask: jax.Array | None = None,
+                             part_range: Tuple[int, int] | None = None
+                             ) -> Tuple[SwarmStore, AnnounceReport]:
+    """Batched put of variable-size values over the mesh — the routed
+    twin of :func:`opendht_tpu.models.chunked_values.announce_chunked`.
+
+    ``payloads [P, parts, W]`` / ``lengths [P]``; ONE routed lookup per
+    base key (all parts share the closest-node set), then one routed
+    insert exchange per active part at its part key.  Parts insert
+    through the UNVERIFIED programs (part keys are key-derived, not
+    content-derived — see the chunked_values module docstring);
+    integrity lives at the read merge.  The report's ``trace`` is the
+    SUM of the per-part mesh-global traces, so whole-sweep conservation
+    (``requests == accepts + rejects``) holds across parts exactly.
+
+    Chaos knobs, composing the republish harness's shapes:
+
+    * ``drop_frac``/``drop_key`` — storage-RPC loss; the key is
+      ``fold_in``-split per part, so loss is independent across parts
+      (a torn write: SOME parts of a value land);
+    * ``part_drop_mask [P, parts]`` — deterministic per-part drops
+      (True = this value's part j is not announced at all);
+    * ``part_range=(lo, hi)`` — announce only parts ``lo ≤ j < hi``: a
+      mid-announce kill between parts (the writer died after part
+      ``hi-1`` left the NIC).  ``replicas`` reports 0 when part 0 is
+      outside the range.
+    """
+    p, parts, w = payloads.shape
+    assert w == scfg.payload_words, (w, scfg.payload_words)
+    payloads, lengths = mask_chunk_payloads(payloads, lengths)
+    words = -(-lengths.astype(jnp.int32) // 4)               # [P]
+    part_scfg = scfg._replace(verify=False)
+    res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
+    lo, hi = part_range if part_range is not None else (0, parts)
+    assert 0 <= lo < hi <= parts, (lo, hi, parts)
+    ttls = jnp.zeros((p,), jnp.uint32)
+    rep0 = jnp.zeros((p,), jnp.int32)
+    trace = StoreTrace.zeros()
+    for j in range(lo, hi):
+        active = (words > j * w) | (j == 0)
+        if part_drop_mask is not None:
+            active = active & ~part_drop_mask[:, j]
+        found_j = jnp.where(active[:, None], res.found, -1)
+        found_j = drop_exchanges(
+            found_j, drop_frac,
+            None if drop_key is None else jax.random.fold_in(drop_key, j))
+        sizes_j = (lengths.astype(jnp.uint32) if j == 0
+                   else jnp.ones((p,), jnp.uint32))
+        store, rep, tr = _sharded_insert(
+            swarm, cfg, store, part_scfg, found_j, part_key(keys, j),
+            vals, seqs, sizes_j, ttls, payloads[:, j], now, mesh,
+            capacity_factor, False, None)
+        trace = trace + tr
+        if j == 0:
+            rep0 = rep
+    return store, AnnounceReport(replicas=rep0, hops=res.hops,
+                                 done=res.done, trace=trace)
+
+
+def sharded_get_chunked(swarm: Swarm, cfg: SwarmConfig,
+                        store: SwarmStore, scfg: StoreConfig,
+                        keys: jax.Array, key: jax.Array, mesh: Mesh,
+                        parts: int, capacity_factor: float = 4.0
+                        ) -> ChunkedGetResult:
+    """Batched get of variable-size values over the mesh — the routed
+    twin of :func:`opendht_tpu.models.chunked_values.get_chunked`,
+    preserving the module contract mesh-wide: ``hit`` iff part 0 is
+    found and every needed part carries part-0's ``(val, seq)``; a
+    torn, partially-dropped or over-budget value reads as MISSING,
+    never truncated or garbled.  With ``scfg.verify`` the reassembled
+    bytes must also hash back to the base key
+    (:func:`~opendht_tpu.models.chunked_values._chunked_root_ok`, in-
+    jit) — a forged or bit-flipped part downgrades the row to missing.
+    """
+    p = keys.shape[0]
+    w = scfg.payload_words
+    part_scfg = scfg._replace(verify=False)
+    res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
+    h0, val, seq, pl0, sz = _sharded_probe_phase(
+        swarm, cfg, store, part_scfg, res.found, keys, mesh,
+        capacity_factor)
+    need_words = -(-sz.astype(jnp.int32) // 4)               # [P]
+    n_parts = jnp.clip(-(-need_words // max(w, 1)), 1, parts)
+    ok = h0 & (need_words <= parts * w)
+    pls = [pl0]
+    for j in range(1, parts):
+        hj, vj, sj, plj, _szj = _sharded_probe_phase(
+            swarm, cfg, store, part_scfg, res.found, part_key(keys, j),
+            mesh, capacity_factor)
+        needed = n_parts > j
+        ok = ok & (~needed | (hj & (vj == val) & (sj == seq)))
+        pls.append(jnp.where(needed[:, None], plj, 0))
+    payload = jnp.concatenate(pls, axis=1)                   # [P,parts*W]
+    idx = jnp.arange(parts * w, dtype=jnp.int32)[None, :]
+    payload = jnp.where(idx < need_words[:, None], payload, 0)
+    if scfg.verify:
+        ok = ok & _chunked_root_ok(keys, payload.reshape(p, parts, w),
+                                   sz.astype(jnp.uint32))
+    payload = jnp.where(ok[:, None], payload, 0)
+    return ChunkedGetResult(
+        hit=ok, val=jnp.where(ok, val, 0), seq=jnp.where(ok, seq, 0),
+        length=jnp.where(ok, sz, 0), payload=payload,
+        hops=res.hops, done=res.done)
+
+
+def sharded_listen_chunked(swarm: Swarm, cfg: SwarmConfig,
+                           store: SwarmStore, scfg: StoreConfig,
+                           keys: jax.Array, reg_ids: jax.Array,
+                           key: jax.Array, mesh: Mesh, parts: int,
+                           capacity_factor: float = 4.0, now=0
+                           ) -> Tuple[SwarmStore, jax.Array]:
+    """Register chunked listeners over the mesh: one routed lookup per
+    base key, a routed listener-table insert per part key — future
+    announces of ANY part deliver into the logical listener's per-part
+    slots, and :func:`sharded_collect_chunked` reassembles the value
+    LIST under the get-merge guard.  Needs ``listen_slots ≥ parts``;
+    all parts ride ONE insert batch so a node holds a registration
+    whole or not at all (see the local twin's docstring)."""
+    res = sharded_lookup(swarm, cfg, keys, key, mesh, capacity_factor)
+    rid = jnp.asarray(reg_ids, jnp.int32)
+    found_b = jnp.tile(res.found, (parts, 1))
+    keys_b = jnp.concatenate([part_key(keys, j) for j in range(parts)])
+    rid_b = jnp.concatenate([jnp.where(rid >= 0, rid * parts + j, -1)
+                             for j in range(parts)])
+    store = _sharded_listen_phase(swarm, cfg, store, scfg, found_b,
+                                  keys_b, rid_b, now, mesh,
+                                  capacity_factor)
+    return store, res.done
+
+
+# Delivery-slot collect/ack/cancel are elementwise over the REPLICATED
+# listener-delivery leaves — shard-local under the store's sharding,
+# so the single-chip ops ARE the sharded ones (same pattern as
+# sharded_ack_listeners).
+
+def sharded_collect_chunked(store: SwarmStore, scfg: StoreConfig,
+                            reg_ids: jax.Array, parts: int,
+                            keys: jax.Array | None = None):
+    """Mesh-wide chunked delivery collect (see
+    :func:`opendht_tpu.models.chunked_values.collect_chunked`)."""
+    return collect_chunked(store, scfg, reg_ids, parts, keys)
+
+
+def sharded_ack_chunked(store: SwarmStore, reg_ids: jax.Array,
+                        parts: int) -> SwarmStore:
+    """Mesh-wide chunked listener ack — consume all part slots."""
+    return ack_chunked(store, reg_ids, parts)
+
+
+def sharded_cancel_chunked(store: SwarmStore, scfg: StoreConfig,
+                           reg_ids: jax.Array, parts: int) -> SwarmStore:
+    """Mesh-wide chunked listener cancel."""
+    return cancel_chunked(store, scfg, reg_ids, parts)

@@ -58,7 +58,8 @@ def _load_row(path: str) -> dict:
         obj = json.load(f)
     if obj.get("kind") in ("swarm_lookup_trace", "swarm_serve_trace",
                            "swarm_monitor_trace", "swarm_index_trace",
-                           "swarm_soak_trace", "swarm_auth_trace"):
+                           "swarm_soak_trace", "swarm_auth_trace",
+                           "swarm_chunked_trace"):
         obj = obj["bench"]                           # ...artifacts
     if "value" not in obj or "metric" not in obj:
         raise ValueError(f"{path}: no BENCH row found (need "
@@ -117,6 +118,34 @@ def check_bench_rows(cur: dict, base: dict,
             if ov is not None and ob is not None and ov > ob:
                 errs.append(f"verify overhead_ratio {ov} above the "
                             f"stated budget {ob}")
+        return errs
+
+    if cur.get("metric") == "swarm_chunked_defended_integrity":
+        # Chunked rows gate as QUALITY on any platform (ISSUE 16):
+        # reassembly exactness and the missing-never-garbled contract
+        # are correctness statements, not machine rates.
+        if cur["value"] != 1.0:
+            errs.append(f"chunked defended integrity {cur['value']} "
+                        f"!= 1.0")
+        if cur.get("garbled_reads") != 0:
+            errs.append(f"garbled_reads {cur.get('garbled_reads')!r} "
+                        f"!= 0 — a torn or forged value was served")
+        if cur.get("torn_missing_rate") != 1.0:
+            errs.append(f"torn_missing_rate "
+                        f"{cur.get('torn_missing_rate')!r} != 1.0")
+        rr = cur.get("root_rejects")
+        if rr is not None and rr < 1:
+            errs.append("root_rejects 0 — the per-part integrity "
+                        "plane never fired under injection")
+        hs = cur.get("heal_sweeps")
+        if hs is not None and hs < 1:
+            errs.append("heal_sweeps 0 — no republish sweep healed "
+                        "the torn values")
+        ui, ub = cur.get("undefended_integrity"), base.get(
+            "undefended_integrity")
+        if ui is not None and ub is not None and ui > ub + 0.1:
+            errs.append(f"undefended integrity {ui} well above the "
+                        f"recorded {ub} — the injection regressed")
         return errs
 
     if cur.get("metric") in COVERAGE_METRICS:

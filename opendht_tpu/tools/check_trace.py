@@ -884,6 +884,21 @@ def check_soak_obj(obj: dict) -> List[str]:
                     f"{scan.get('arrived')} != completed "
                     f"{scan.get('completed')} + pending "
                     f"{scan.get('pending')}")
+    chunked = life.get("chunked") or {}
+    if chunked:
+        # Chunked station (ISSUE 16): opt-in like the scan block —
+        # conserves arrivals, and a chunked READ that completed must
+        # be byte-exact or missing, never garbled.
+        if chunked.get("arrived") != chunked.get("completed", 0) \
+                + chunked.get("pending", 0):
+            errs.append(f"chunked station does not conserve: arrived "
+                        f"{chunked.get('arrived')} != completed "
+                        f"{chunked.get('completed')} + pending "
+                        f"{chunked.get('pending')}")
+        if chunked.get("garbled", 0) != 0:
+            errs.append(f"chunked station served "
+                        f"{chunked.get('garbled')} garbled reads — "
+                        f"the contract is missing, NEVER garbled")
     if life.get("cache_slots"):
         # Probe-fused soak cache (ISSUE 13 satellite): every READ
         # admission is exactly one of hit (instant completion, no
@@ -1384,6 +1399,259 @@ def check_auth_obj(obj: dict) -> List[str]:
     return errs
 
 
+# ---------------------------------------------------------------------------
+# chunked-value chaos artifacts (bench --mode chunked, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+# The undefended arm must be visibly garbled under the single-part
+# forge or the injection never bit and the defended 1.0 proves
+# nothing (same rationale as AUTH_MIN_DEFENSE_GAIN).
+CHUNK_MIN_DEFENSE_GAIN = 0.10
+_CHUNK_TRACE_FIELDS = _AUTH_TRACE_FIELDS
+_CHUNK_LEGS = ("clean", "torn_drop", "kill_mid", "torn_overwrite",
+               "forge")
+# Legs whose injection tears SOME parts of a value: every affected
+# row must read back MISSING — never truncated, never garbled.
+_CHUNK_TORN_LEGS = ("torn_drop", "kill_mid", "torn_overwrite")
+
+
+def _chunk_integrity(legs: dict) -> float:
+    """Reproduce an arm's integrity from its per-leg counters: the
+    fraction of served (hit) rows that were byte-exact against the
+    pre-announce oracle, across every leg.  1.0 when nothing hit."""
+    hits = sum(legs[ln]["hit"] for ln in _CHUNK_LEGS)
+    exact = sum(legs[ln]["exact"] for ln in _CHUNK_LEGS)
+    return 1.0 if hits == 0 else exact / hits
+
+
+def check_chunked_obj(obj: dict) -> List[str]:
+    """All violations found in a loaded ``swarm_chunked_trace``
+    artifact (empty = pass).  The chunked gate's contract (ISSUE 16):
+
+    a. **digest parity** — the device chunked content-id kernel
+       (hash-list root over per-part SHA-1 digests) agreed with
+       hashlib on the announced rows (``digest_parity`` true);
+    b. **parts conservation, exact** — every leg's StoreTrace (the
+       SUM over per-part routed insert exchanges) conserves
+       ``requests == accepts + rejects + integrity_rejects`` in BOTH
+       arms, with ``integrity_rejects == 0`` everywhere (parts ride
+       the unverified insert programs by design; the defense lives at
+       the get-merge), and the clean leg's summed trace equals the
+       whole-value oracle (``conservation.requests ==
+       oracle_requests``, same for ``accepts_new``);
+    c. **exact reassembly** — the clean leg reads every value back
+       byte-exact in both arms (``hit == exact == values``,
+       ``garbled == 0``);
+    d. **missing, never garbled** — the defended arm served ZERO
+       garbled rows across all legs, and on every torn leg (per-part
+       drop, mid-announce kill, higher-seq torn overwrite) every
+       affected row read back missing (``hit == values - affected``,
+       ``torn_missing_rate`` exactly 1.0);
+    e. **the defense fired** — the defended arm's forge leg served no
+       affected row and booked ``root_rejects >= affected`` at the
+       get-merge, the undefended arm is garbled on at least the
+       affected rows, defended integrity is exactly 1.0 and the
+       undefended arm is degraded by at least
+       :data:`CHUNK_MIN_DEFENSE_GAIN`; both stated integrities are
+       reproducible from the per-leg counters;
+    f. **heal** — the churn leg's torn values were re-replicated by
+       republish sweeps: ``post_hit == values`` with zero garbled, in
+       at least one sweep.
+    """
+    errs: List[str] = []
+    for field in ("kind", "bench", "params", "conservation", "arms",
+                  "heal"):
+        if field not in obj:
+            errs.append(f"missing top-level field {field!r}")
+    if errs:
+        return errs
+    bench, arms, cons = obj["bench"], obj["arms"], obj["conservation"]
+    heal, params = obj["heal"], obj["params"]
+    values = params.get("values")
+    if not (_num(values) and values > 0):
+        errs.append(f"params.values invalid: {values!r}")
+        return errs
+
+    # (a) digest parity
+    if obj.get("digest_parity") is not True:
+        errs.append("digest_parity is not true — the device chunked "
+                    "content-id kernel disagreed with hashlib")
+
+    # (b) per-leg structure + parts conservation, both arms
+    for arm_name in ("defended", "undefended"):
+        arm = arms.get(arm_name)
+        if not isinstance(arm, dict):
+            errs.append(f"arm {arm_name!r} missing")
+            return errs
+        legs = arm.get("legs") or {}
+        for leg_name in _CHUNK_LEGS:
+            leg = legs.get(leg_name)
+            if not isinstance(leg, dict):
+                errs.append(f"{arm_name}: leg {leg_name!r} missing")
+                continue
+            bad = [f for f in ("hit", "missing", "garbled", "exact",
+                               "affected")
+                   if not (_num(leg.get(f)) and leg[f] >= 0)]
+            if bad:
+                errs.append(f"{arm_name}/{leg_name}: missing/negative "
+                            f"counters {bad}")
+                continue
+            if leg["hit"] + leg["missing"] != values:
+                errs.append(f"{arm_name}/{leg_name}: hit {leg['hit']} "
+                            f"+ missing {leg['missing']} != values "
+                            f"{values}")
+            if leg["exact"] + leg["garbled"] != leg["hit"]:
+                errs.append(f"{arm_name}/{leg_name}: exact "
+                            f"{leg['exact']} + garbled "
+                            f"{leg['garbled']} != hit {leg['hit']}")
+            tr = leg.get("trace")
+            if not isinstance(tr, dict):
+                errs.append(f"{arm_name}/{leg_name}: trace missing")
+                continue
+            bad = [f for f in _CHUNK_TRACE_FIELDS
+                   if not (_num(tr.get(f)) and tr[f] >= 0)]
+            if bad:
+                errs.append(f"{arm_name}/{leg_name}: trace "
+                            f"missing/negative counters {bad}")
+                continue
+            want = tr["accepts_update"] + tr["accepts_new"] \
+                + tr["rejects"] + tr["integrity_rejects"]
+            if tr["requests"] != want:
+                errs.append(
+                    f"{arm_name}/{leg_name}: part-summed requests "
+                    f"{tr['requests']} != accepts + rejects + "
+                    f"integrity_rejects = {want} (conservation is "
+                    f"EXACT across parts by construction)")
+            if tr["integrity_rejects"] != 0:
+                errs.append(
+                    f"{arm_name}/{leg_name}: integrity_rejects "
+                    f"{tr['integrity_rejects']} != 0 — parts ride the "
+                    f"unverified insert by design; a nonzero count "
+                    f"means the write path silently ran the verify")
+    if errs:
+        return errs
+
+    # (b) clean-leg parts-conservation vs the whole-value oracle
+    for f in ("requests", "accepts_new"):
+        got, want = cons.get(f), cons.get(f"oracle_{f}")
+        if not (_num(got) and got > 0):
+            errs.append(f"conservation.{f} invalid: {got!r}")
+        elif got != want:
+            errs.append(f"conservation.{f} {got} != whole-value "
+                        f"oracle {want}")
+
+    # (c) exact reassembly on the clean leg, both arms
+    for arm_name in ("defended", "undefended"):
+        leg = arms[arm_name]["legs"]["clean"]
+        if not (leg["hit"] == leg["exact"] == values
+                and leg["garbled"] == 0):
+            errs.append(f"{arm_name}/clean: not byte-exact — hit "
+                        f"{leg['hit']}, exact {leg['exact']}, garbled "
+                        f"{leg['garbled']} over {values} values")
+
+    # (d) missing-never-garbled on the defended arm
+    dlegs = arms["defended"]["legs"]
+    g_total = sum(dlegs[ln]["garbled"] for ln in _CHUNK_LEGS)
+    if g_total != 0:
+        errs.append(f"defended arm served {g_total} garbled rows — "
+                    f"the contract is missing, NEVER garbled")
+    for leg_name in _CHUNK_TORN_LEGS:
+        leg = dlegs[leg_name]
+        if leg["affected"] <= 0:
+            errs.append(f"defended/{leg_name}: affected 0 — the "
+                        f"injection never bit, the leg gates nothing")
+        elif leg["hit"] != values - leg["affected"]:
+            errs.append(
+                f"defended/{leg_name}: hit {leg['hit']} != values "
+                f"{values} - affected {leg['affected']} — a torn row "
+                f"was served (or an untorn row was lost)")
+    tmr = bench.get("torn_missing_rate")
+    if tmr != 1.0:
+        errs.append(f"bench torn_missing_rate {tmr!r} != 1.0 — a "
+                    f"torn value read back as something other than "
+                    f"missing")
+
+    # (e) the defense fired
+    fd = dlegs["forge"]
+    if fd["affected"] <= 0:
+        errs.append("defended/forge: affected 0 — no part was forged")
+    else:
+        if fd["hit"] != values - fd["affected"]:
+            errs.append(f"defended/forge: hit {fd['hit']} != values "
+                        f"{values} - affected {fd['affected']} — a "
+                        f"forged row entered a result set")
+        rr = fd.get("root_rejects")
+        if not (_num(rr) and rr >= fd["affected"]):
+            errs.append(f"defended/forge: root_rejects {rr!r} < "
+                        f"affected {fd['affected']} — the get-merge "
+                        f"never booked the rejections")
+        fu = arms["undefended"]["legs"]["forge"]
+        if fu["garbled"] < fd["affected"]:
+            errs.append(f"undefended/forge: garbled {fu['garbled']} <"
+                        f" affected {fd['affected']} — the forge "
+                        f"never bit, the defended arm proves nothing")
+    d_int = arms["defended"].get("integrity")
+    u_int = arms["undefended"].get("integrity")
+    if d_int != 1.0:
+        errs.append(f"defended integrity {d_int!r} != 1.0 — a garbled"
+                    f" reassembly entered a result set")
+    if not (_num(u_int)
+            and u_int <= (d_int or 1.0) - CHUNK_MIN_DEFENSE_GAIN):
+        errs.append(f"undefended integrity {u_int!r} not degraded by "
+                    f">= {CHUNK_MIN_DEFENSE_GAIN} — the injection "
+                    f"never bit, so the defended 1.0 proves nothing")
+    for arm_name in ("defended", "undefended"):
+        arm = arms[arm_name]
+        stated, derived = arm.get("integrity"), _chunk_integrity(
+            arm["legs"])
+        if not (_num(stated) and abs(stated - derived) <= 1e-9):
+            errs.append(f"{arm_name} integrity {stated!r} not "
+                        f"reproducible from the per-leg counters "
+                        f"({derived:.6f})")
+
+    # (f) heal by republish
+    bad = [f for f in ("pre_hit", "post_hit", "sweeps")
+           if not (_num(heal.get(f)) and heal[f] >= 0)]
+    if bad:
+        errs.append(f"heal: missing/negative fields {bad}")
+    else:
+        if heal["pre_hit"] >= values:
+            errs.append(f"heal: pre_hit {heal['pre_hit']} not below "
+                        f"values {values} — nothing was torn, the "
+                        f"heal leg gates nothing")
+        if heal["post_hit"] != values:
+            errs.append(f"heal: post_hit {heal['post_hit']} != values"
+                        f" {values} — republish did not re-replicate "
+                        f"every torn value")
+        if heal["sweeps"] < 1:
+            errs.append("heal: no republish sweep completed")
+        if heal.get("post_garbled") != 0:
+            errs.append(f"heal: post_garbled "
+                        f"{heal.get('post_garbled')!r} != 0")
+
+    # bench-row cross-checks
+    if bench.get("value") != d_int:
+        errs.append(f"bench value {bench.get('value')!r} != defended "
+                    f"integrity {d_int!r}")
+    if bench.get("undefended_integrity") != u_int:
+        errs.append(f"bench undefended_integrity "
+                    f"{bench.get('undefended_integrity')!r} != arm "
+                    f"{u_int!r}")
+    if bench.get("garbled_reads") != g_total:
+        errs.append(f"bench garbled_reads "
+                    f"{bench.get('garbled_reads')!r} != defended-arm "
+                    f"sum {g_total}")
+    if bench.get("root_rejects") != dlegs["forge"].get("root_rejects"):
+        errs.append(f"bench root_rejects "
+                    f"{bench.get('root_rejects')!r} != forge leg "
+                    f"{dlegs['forge'].get('root_rejects')!r}")
+    if _num(heal.get("sweeps")) \
+            and bench.get("heal_sweeps") != heal["sweeps"]:
+        errs.append(f"bench heal_sweeps {bench.get('heal_sweeps')!r} "
+                    f"!= heal block {heal['sweeps']}")
+    return errs
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) != 1:
@@ -1448,6 +1716,22 @@ def main(argv=None) -> int:
               f"{b['integrity_rejects']} forged rows rejected in-jit, "
               f"verify overhead {b['overhead_ratio']:+.1%} "
               f"(budget {b['overhead_budget']:.0%})")
+        return 0
+    if obj.get("kind") == "swarm_chunked_trace":
+        errs = check_chunked_obj(obj)
+        if errs:
+            for e in errs:
+                print(f"check_trace: {e}")
+            return 1
+        b = obj["bench"]
+        print(f"check_trace: chunked OK — defended integrity "
+              f"{b['value']} vs undefended "
+              f"{b['undefended_integrity']:.4f}, "
+              f"{b['garbled_reads']} garbled reads, "
+              f"{b['root_rejects']} forged rows rejected at the "
+              f"get-merge, torn==missing "
+              f"{b['torn_missing_rate']:.0%}, healed in "
+              f"{b['heal_sweeps']} sweep(s)")
         return 0
     if obj.get("kind") == "swarm_index_trace":
         errs = check_index_obj(obj)

@@ -193,6 +193,8 @@ REGISTRY_MODULES = {
     "opendht_tpu.models.monitor": "opendht_tpu/models/monitor.py",
     "opendht_tpu.models.index": "opendht_tpu/models/index.py",
     "opendht_tpu.models.integrity": "opendht_tpu/models/integrity.py",
+    "opendht_tpu.models.chunked_values":
+        "opendht_tpu/models/chunked_values.py",
     "opendht_tpu.ops.sha1": "opendht_tpu/ops/sha1.py",
     "opendht_tpu.parallel.sharded": "opendht_tpu/parallel/sharded.py",
     "opendht_tpu.parallel.sharded_storage":
@@ -1888,6 +1890,18 @@ def _build_workloads():
             jnp.asarray([0, 55, 56, 64], jnp.int32))
         sha1_blocks(blocks, n_blocks)
 
+    def chunked_plane():
+        # The chunked-value integrity jits (ISSUE 16): the hash-list
+        # root mint (writer side) and the reader-side root check that
+        # guards the chunked get-merge, at the bench's shapes.
+        from ..models import chunked_values as cv
+        pls = jax.random.bits(jax.random.PRNGKey(41), (64, 4, 2),
+                              jnp.uint32)
+        lens = jax.random.bits(jax.random.PRNGKey(42), (64,),
+                               jnp.uint32) % 33
+        ckeys = cv.chunked_content_ids(pls, lens)
+        cv._chunked_root_ok(ckeys, pls, lens)
+
     def index_kernels():
         # The device-PHT encoding jits: linearize → trie-node SHA-1 →
         # entry payload pack, plus the batched SHA-1 standalone (it is
@@ -2011,6 +2025,7 @@ def _build_workloads():
         "soak-engine": soak_engine,
         "storage-paths": storage_paths,
         "integrity-plane": integrity_plane,
+        "chunked-plane": chunked_plane,
         "index-kernels": index_kernels,
         "monitor-sweep": monitor_sweep,
         "sharded-engines": sharded_engines,

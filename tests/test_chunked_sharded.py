@@ -1,0 +1,517 @@
+"""Chunked values on the sharded engine (ISSUE 16): routed announce /
+get / listen of multi-part values must preserve the local module's
+contract MESH-WIDE — a torn, partially-dropped or forged value reads
+back as missing, never truncated or garbled — with StoreTrace sums
+exact across per-part insert exchanges, and the ``swarm_chunked_trace``
+artifact checker pinned by bit-identical pass/fail fixtures.
+
+Contracts:
+
+* **parts conservation** — the announce report's trace is the SUM of
+  the per-part mesh-global traces; against a whole-value oracle built
+  from the routed lookup's found set it is EXACT (requests equals the
+  oracle's active-part placements; at ``capacity_factor=inf`` on an
+  empty store every placement is an ``accepts_new``);
+* **edge shapes** — zero-length and single-part values round-trip on
+  the mesh byte-exact (the PR-1 local edge tests, routed);
+* **torn == missing** — a ``capacity_factor``-induced part loss, a
+  per-part drop mask, a mid-announce kill (``part_range``) and a
+  higher-seq torn overwrite all read back missing on the mesh; hit
+  rows stay byte-exact in every case;
+* **forged part rejected at the get-merge** — with ``scfg.verify`` a
+  single-part bit-flip downgrades the row to missing in-jit; the
+  undefended arm serves the garbled bytes (the injection bites);
+* **value-list listeners** — chunked listeners deliver whole value
+  lists mesh-wide and acks consume all part slots.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opendht_tpu.models.storage import StoreConfig
+from opendht_tpu.models.swarm import SwarmConfig, build_swarm
+from opendht_tpu.models import chunked_values as cv
+from opendht_tpu.tools.check_trace import check_chunked_obj
+
+P_, PARTS, W = 64, 4, 2
+CFG = SwarmConfig.for_nodes(8192)
+
+
+def _conserves(tr: dict) -> bool:
+    return tr["requests"] == tr["accepts_update"] + tr["accepts_new"] \
+        + tr["rejects"] + tr["integrity_rejects"]
+
+
+def _mk_scfg(slots: int = 8, verify: bool = True) -> StoreConfig:
+    return StoreConfig(slots=slots, listen_slots=16,
+                       max_listeners=P_ * PARTS, payload_words=W
+                       )._replace(verify=verify)
+
+
+def _mk_values(seed: int = 1, p: int = P_):
+    """Random chunked rows: exactly ONE zero-length row (all
+    zero-length values share one content key — two would collide),
+    one sub-word row, one max-size row, the rest uniform."""
+    rng = np.random.default_rng(seed)
+    payloads = jnp.asarray(rng.integers(
+        0, 2 ** 32, (p, PARTS, W), dtype=np.uint64).astype(np.uint32))
+    lengths = rng.integers(1, PARTS * W * 4 + 1, (p,),
+                           dtype=np.int64).astype(np.uint32)
+    lengths[0], lengths[1], lengths[2] = 0, 3, PARTS * W * 4
+    lengths = jnp.asarray(lengths)
+    keys = cv.chunked_content_ids(payloads, lengths)
+    assert np.array_equal(
+        np.asarray(keys),
+        cv.chunked_content_ids_host(np.asarray(payloads),
+                                    np.asarray(lengths)))
+    vals = jnp.arange(1, p + 1, dtype=jnp.uint32)
+    seqs = jnp.full((p,), 5, jnp.uint32)
+    masked, _ = cv.mask_chunk_payloads(payloads, lengths)
+    oracle = np.asarray(masked).reshape(p, PARTS * W)
+    return keys, vals, seqs, payloads, lengths, oracle
+
+
+@pytest.mark.usefixtures("mesh8")
+class TestChunkedSharded:
+    @pytest.fixture(scope="class")
+    def mesh8(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        from opendht_tpu.parallel import make_mesh
+        return make_mesh(8)
+
+    @pytest.fixture(scope="class")
+    def swarm(self):
+        return build_swarm(jax.random.PRNGKey(2), CFG)
+
+    def test_parts_conservation_vs_whole_value_oracle(self, mesh8,
+                                                      swarm):
+        from opendht_tpu.parallel.sharded import sharded_lookup
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_announce_chunked, sharded_empty_store,
+        )
+        keys, vals, seqs, pls, lens, _oracle = _mk_values()
+        scfg = _mk_scfg(slots=32)
+        store = sharded_empty_store(CFG.n_nodes, scfg, mesh8)
+        store, rep = sharded_announce_chunked(
+            swarm, CFG, store, scfg, keys, vals, seqs, 10,
+            jax.random.PRNGKey(5), mesh8, pls, lens,
+            capacity_factor=float("inf"))
+        tr = rep.trace.to_dict()
+        assert _conserves(tr), tr
+        assert tr["integrity_rejects"] == 0
+        # Whole-value oracle: the same seeded lookup yields the same
+        # found set; each value places every ACTIVE part (words > j*W,
+        # part 0 always) on every found node — at inf capacity on an
+        # empty store that is exactly the summed requests, and every
+        # placement is a fresh accept.
+        res = sharded_lookup(swarm, CFG, keys, jax.random.PRNGKey(5),
+                             mesh8, float("inf"))
+        found_per_row = (np.asarray(res.found) >= 0).sum(axis=1)
+        words = (np.asarray(lens).astype(np.int64) + 3) // 4
+        oracle_requests = sum(
+            int(found_per_row[(words > j * W) | (j == 0)].sum())
+            for j in range(PARTS))
+        assert tr["requests"] == oracle_requests
+        assert tr["accepts_new"] == oracle_requests
+        assert int(jnp.min(rep.replicas)) > 0
+
+    def test_zero_length_and_single_part_roundtrip(self, mesh8,
+                                                   swarm):
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_announce_chunked, sharded_empty_store,
+            sharded_get_chunked,
+        )
+        # Single-part engine (parts=1): values fit one payload row,
+        # including ONE zero-length value — the routed twins of the
+        # PR-1 local edge tests.
+        p = 8
+        rng = np.random.default_rng(3)
+        pls = jnp.asarray(rng.integers(
+            0, 2 ** 32, (p, 1, W), dtype=np.uint64).astype(np.uint32))
+        lens = rng.integers(1, W * 4 + 1, (p,),
+                            dtype=np.int64).astype(np.uint32)
+        lens[0] = 0
+        lens = jnp.asarray(lens)
+        keys = cv.chunked_content_ids(pls, lens)
+        vals = jnp.arange(1, p + 1, dtype=jnp.uint32)
+        seqs = jnp.ones((p,), jnp.uint32)
+        scfg = _mk_scfg()
+        store = sharded_empty_store(CFG.n_nodes, scfg, mesh8)
+        store, rep = sharded_announce_chunked(
+            swarm, CFG, store, scfg, keys, vals, seqs, 0,
+            jax.random.PRNGKey(7), mesh8, pls, lens,
+            capacity_factor=float("inf"))
+        assert int(jnp.min(rep.replicas)) > 0
+        res = sharded_get_chunked(
+            swarm, CFG, store, scfg, keys, jax.random.PRNGKey(8),
+            mesh8, 1, capacity_factor=float("inf"))
+        assert bool(jnp.all(res.hit))
+        assert np.array_equal(np.asarray(res.length), np.asarray(lens))
+        masked, _ = cv.mask_chunk_payloads(pls, lens)
+        assert np.array_equal(np.asarray(res.payload),
+                              np.asarray(masked).reshape(p, W))
+        # The zero-length row hit with length 0 and all-zero bytes.
+        assert bool(res.hit[0]) and int(res.length[0]) == 0
+        assert not np.asarray(res.payload)[0].any()
+
+    def test_multipart_roundtrip_byte_exact(self, mesh8, swarm):
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_announce_chunked, sharded_empty_store,
+            sharded_get_chunked,
+        )
+        keys, vals, seqs, pls, lens, oracle = _mk_values()
+        scfg = _mk_scfg()
+        store = sharded_empty_store(CFG.n_nodes, scfg, mesh8)
+        store, rep = sharded_announce_chunked(
+            swarm, CFG, store, scfg, keys, vals, seqs, 10,
+            jax.random.PRNGKey(5), mesh8, pls, lens,
+            capacity_factor=float("inf"))
+        assert int(jnp.min(rep.replicas)) > 0
+        res = sharded_get_chunked(
+            swarm, CFG, store, scfg, keys, jax.random.PRNGKey(6),
+            mesh8, PARTS, capacity_factor=float("inf"))
+        assert bool(jnp.all(res.hit))
+        assert np.array_equal(np.asarray(res.length), np.asarray(lens))
+        assert np.array_equal(np.asarray(res.payload), oracle)
+
+    def test_part_drop_mask_torn_reads_missing(self, mesh8, swarm):
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_announce_chunked, sharded_empty_store,
+            sharded_get_chunked,
+        )
+        keys, vals, seqs, pls, lens, oracle = _mk_values()
+        scfg = _mk_scfg()
+        # Drop part 1 of every value: rows needing it must read
+        # missing; rows fitting part 0 alone are untouched.
+        mask = np.zeros((P_, PARTS), bool)
+        mask[:, 1] = True
+        store = sharded_empty_store(CFG.n_nodes, scfg, mesh8)
+        store, _ = sharded_announce_chunked(
+            swarm, CFG, store, scfg, keys, vals, seqs, 10,
+            jax.random.PRNGKey(5), mesh8, pls, lens,
+            capacity_factor=float("inf"),
+            part_drop_mask=jnp.asarray(mask))
+        res = sharded_get_chunked(
+            swarm, CFG, store, scfg, keys, jax.random.PRNGKey(6),
+            mesh8, PARTS, capacity_factor=float("inf"))
+        need = (np.asarray(lens).astype(np.int64) + 3) // 4 > W
+        hit = np.asarray(res.hit)
+        assert not hit[need].any(), "torn rows must read missing"
+        assert hit[~need].all(), "un-torn rows must be unaffected"
+        assert np.array_equal(np.asarray(res.payload)[hit],
+                              oracle[hit])
+
+    def test_capacity_drop_torn_reads_missing(self, mesh8, swarm):
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_announce_chunked, sharded_empty_store,
+            sharded_get_chunked,
+        )
+        keys, vals, seqs, pls, lens, oracle = _mk_values()
+        scfg = _mk_scfg()
+        store = sharded_empty_store(CFG.n_nodes, scfg, mesh8)
+        # A starved routing capacity silently drops part placements:
+        # a capacity-torn value must read back MISSING, and every row
+        # that still hits must be byte-exact — never truncated.
+        store, _ = sharded_announce_chunked(
+            swarm, CFG, store, scfg, keys, vals, seqs, 10,
+            jax.random.PRNGKey(5), mesh8, pls, lens,
+            capacity_factor=0.25)
+        res = sharded_get_chunked(
+            swarm, CFG, store, scfg, keys, jax.random.PRNGKey(6),
+            mesh8, PARTS, capacity_factor=float("inf"))
+        hit = np.asarray(res.hit)
+        assert not hit.all(), \
+            "capacity starvation should tear at least one value"
+        assert np.array_equal(np.asarray(res.payload)[hit],
+                              oracle[hit])
+        assert not np.asarray(res.payload)[~hit].any()
+        assert (np.asarray(res.length)[~hit] == 0).all()
+
+    def test_mid_announce_kill_and_torn_overwrite(self, mesh8, swarm):
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_announce_chunked, sharded_empty_store,
+            sharded_get_chunked,
+        )
+        keys, vals, seqs, pls, lens, oracle = _mk_values()
+        scfg = _mk_scfg()
+        # Mid-announce kill: the writer died after part 0 left the
+        # NIC (part_range=(0, 1)) — only single-part values land.
+        store = sharded_empty_store(CFG.n_nodes, scfg, mesh8)
+        store, _ = sharded_announce_chunked(
+            swarm, CFG, store, scfg, keys, vals, seqs, 10,
+            jax.random.PRNGKey(5), mesh8, pls, lens,
+            capacity_factor=float("inf"), part_range=(0, 1))
+        res = sharded_get_chunked(
+            swarm, CFG, store, scfg, keys, jax.random.PRNGKey(6),
+            mesh8, PARTS, capacity_factor=float("inf"))
+        need = (np.asarray(lens).astype(np.int64) + 3) // 4 > W
+        hit = np.asarray(res.hit)
+        assert not hit[need].any()
+        assert hit[~need].all()
+        # Higher-seq torn overwrite on a FULL store: part 0 advances
+        # to seq+1, parts 1.. stay at seq — the (val, seq) guard must
+        # downgrade every multi-part row to missing, in BOTH verify
+        # modes (the guard is reassembly logic, not the verify plane).
+        for verify in (False, True):
+            scfg_m = _mk_scfg(verify=verify)
+            st = sharded_empty_store(CFG.n_nodes, scfg_m, mesh8)
+            st, _ = sharded_announce_chunked(
+                swarm, CFG, st, scfg_m, keys, vals, seqs, 10,
+                jax.random.PRNGKey(5), mesh8, pls, lens,
+                capacity_factor=float("inf"))
+            st, _ = sharded_announce_chunked(
+                swarm, CFG, st, scfg_m, keys, vals, seqs + 1, 11,
+                jax.random.PRNGKey(5), mesh8, pls, lens,
+                capacity_factor=float("inf"), part_range=(0, 1))
+            r2 = sharded_get_chunked(
+                swarm, CFG, st, scfg_m, keys, jax.random.PRNGKey(6),
+                mesh8, PARTS, capacity_factor=float("inf"))
+            h2 = np.asarray(r2.hit)
+            assert not h2[need].any(), f"verify={verify}"
+            assert h2[~need].all(), f"verify={verify}"
+
+    def test_forged_part_rejected_at_get_merge(self, mesh8, swarm):
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_announce_chunked, sharded_empty_store,
+            sharded_get_chunked,
+        )
+        keys, vals, seqs, pls, lens, oracle = _mk_values()
+        # Forge: re-announce every part at seq+1 with ONE word of
+        # part 2 bit-flipped.  The equal-seq edit policy would reject
+        # same-seq different bytes, so the attacker must advance seq —
+        # exactly the overwrite the root check exists to stop.
+        forged = np.asarray(pls).copy()
+        forged[:, 2, 0] ^= 1
+        forged = jnp.asarray(forged)
+        affected = (np.asarray(lens).astype(np.int64) + 3) // 4 \
+            > 2 * W
+        results = {}
+        for verify in (False, True):
+            scfg = _mk_scfg(verify=verify)
+            st = sharded_empty_store(CFG.n_nodes, scfg, mesh8)
+            for sq, pl, t, k in ((seqs, pls, 10, 5),
+                                 (seqs + 1, forged, 11, 7)):
+                st, _ = sharded_announce_chunked(
+                    swarm, CFG, st, scfg, keys, vals, sq, t,
+                    jax.random.PRNGKey(k), mesh8, pl, lens,
+                    capacity_factor=float("inf"))
+            results[verify] = sharded_get_chunked(
+                swarm, CFG, st, scfg, keys, jax.random.PRNGKey(6),
+                mesh8, PARTS, capacity_factor=float("inf"))
+        hu = np.asarray(results[False].hit)
+        garbled = hu & np.any(
+            np.asarray(results[False].payload) != oracle, axis=1)
+        assert garbled[affected].all(), \
+            "undefended arm must serve the garbled bytes"
+        hd = np.asarray(results[True].hit)
+        assert not hd[affected].any(), \
+            "defended arm must reject every forged row in-jit"
+        assert hd[~affected].all()
+        assert np.array_equal(np.asarray(results[True].payload)[hd],
+                              oracle[hd])
+
+    def test_chunked_listeners_deliver_value_lists(self, mesh8,
+                                                   swarm):
+        from opendht_tpu.parallel.sharded_storage import (
+            sharded_ack_chunked, sharded_announce_chunked,
+            sharded_collect_chunked, sharded_empty_store,
+            sharded_listen_chunked,
+        )
+        keys, vals, seqs, pls, lens, oracle = _mk_values()
+        scfg = _mk_scfg()
+        store = sharded_empty_store(CFG.n_nodes, scfg, mesh8)
+        reg = jnp.arange(P_, dtype=jnp.int32)
+        store, _done = sharded_listen_chunked(
+            swarm, CFG, store, scfg, keys, reg,
+            jax.random.PRNGKey(8), mesh8, PARTS,
+            capacity_factor=float("inf"))
+        store, _ = sharded_announce_chunked(
+            swarm, CFG, store, scfg, keys, vals, seqs, 12,
+            jax.random.PRNGKey(9), mesh8, pls, lens,
+            capacity_factor=float("inf"))
+        col = sharded_collect_chunked(store, scfg, reg, PARTS, keys)
+        assert bool(np.asarray(col.ready).all())
+        assert np.array_equal(np.asarray(col.payload), oracle)
+        assert np.array_equal(np.asarray(col.length),
+                              np.asarray(lens))
+        assert np.array_equal(np.asarray(col.val), np.asarray(vals))
+        store = sharded_ack_chunked(store, reg, PARTS)
+        col2 = sharded_collect_chunked(store, scfg, reg, PARTS, keys)
+        assert not np.asarray(col2.ready).any(), "ack must consume"
+
+
+# ---------------------------------------------------------------------------
+# swarm_chunked_trace checker fixtures — bit-identical pass AND fail
+# ---------------------------------------------------------------------------
+
+def _trace(req, au=0, an=0, rej=0, integ=0, notified=0):
+    return {"requests": req, "accepts_update": au, "accepts_new": an,
+            "rejects": rej, "notified": notified,
+            "integrity_rejects": integ}
+
+
+def _leg(values, hit, garbled=0, affected=0, req=1024, **tr):
+    return {"hit": hit, "missing": values - hit, "garbled": garbled,
+            "exact": hit - garbled, "affected": affected,
+            "trace": _trace(req, **(tr or {"an": req}))}
+
+
+def _chunked_obj():
+    values = 64
+    legs_d = {
+        "clean": _leg(values, values, req=1408, an=1408),
+        "torn_drop": _leg(values, 30, affected=34, req=1136,
+                          an=1136),
+        "kill_mid": _leg(values, 30, affected=34, req=512, an=512),
+        "torn_overwrite": _leg(values, 30, affected=34, req=1920,
+                               an=1408, au=512),
+        "forge": _leg(values, 30, affected=34, req=1408, au=1408),
+    }
+    legs_d["forge"]["root_rejects"] = 34
+    legs_u = {
+        "clean": _leg(values, values, req=1408, an=1408),
+        "torn_drop": _leg(values, 30, affected=34, req=1136,
+                          an=1136),
+        "kill_mid": _leg(values, 30, affected=34, req=512, an=512),
+        "torn_overwrite": _leg(values, 30, affected=34, req=1920,
+                               an=1408, au=512),
+        "forge": _leg(values, values, garbled=34, affected=34,
+                      req=1408, au=1408),
+    }
+    d_hits = sum(lg["hit"] for lg in legs_d.values())
+    u_hits = sum(lg["hit"] for lg in legs_u.values())
+    u_int = (u_hits - 34) / u_hits
+    bench = {
+        "metric": "swarm_chunked_defended_integrity", "value": 1.0,
+        "unit": "frac", "undefended_integrity": u_int,
+        "garbled_reads": 0, "torn_missing_rate": 1.0,
+        "root_rejects": 34, "heal_sweeps": 1, "platform": "cpu",
+    }
+    assert d_hits == sum(lg["exact"] for lg in legs_d.values())
+    return {
+        "kind": "swarm_chunked_trace",
+        "bench": bench,
+        "params": {"values": values, "parts": 4, "payload_words": 2,
+                   "nodes": 8192},
+        "digest_parity": True,
+        "conservation": {"requests": 1408, "oracle_requests": 1408,
+                         "accepts_new": 1408,
+                         "oracle_accepts_new": 1408},
+        "arms": {
+            "defended": {"integrity": 1.0, "legs": legs_d},
+            "undefended": {"integrity": u_int, "legs": legs_u},
+        },
+        "heal": {"pre_hit": 30, "post_hit": values, "sweeps": 1,
+                 "post_garbled": 0},
+    }
+
+
+class TestChunkedChecker:
+    def test_fixture_passes(self):
+        assert check_chunked_obj(_chunked_obj()) == []
+
+    def test_defended_garbled_fails(self):
+        obj = _chunked_obj()
+        leg = obj["arms"]["defended"]["legs"]["torn_drop"]
+        leg["garbled"], leg["exact"] = 1, leg["hit"] - 1
+        errs = check_chunked_obj(obj)
+        assert any("NEVER garbled" in e for e in errs), errs
+
+    def test_torn_row_served_fails(self):
+        obj = _chunked_obj()
+        leg = obj["arms"]["defended"]["legs"]["kill_mid"]
+        leg["hit"] += 1
+        leg["missing"] -= 1
+        leg["exact"] += 1
+        errs = check_chunked_obj(obj)
+        assert any("torn row was served" in e for e in errs), errs
+
+    def test_parts_conservation_break_fails(self):
+        obj = _chunked_obj()
+        obj["arms"]["defended"]["legs"]["clean"]["trace"][
+            "requests"] += 1
+        errs = check_chunked_obj(obj)
+        assert any("EXACT across parts" in e for e in errs), errs
+
+    def test_oracle_mismatch_fails(self):
+        obj = _chunked_obj()
+        obj["conservation"]["requests"] = 1407
+        errs = check_chunked_obj(obj)
+        assert any("whole-value oracle" in e for e in errs), errs
+
+    def test_write_path_verify_leak_fails(self):
+        # Parts ride the UNVERIFIED insert by design; a nonzero
+        # integrity_rejects means the off-plane ran anyway.
+        obj = _chunked_obj()
+        tr = obj["arms"]["undefended"]["legs"]["clean"]["trace"]
+        tr["integrity_rejects"], tr["accepts_new"] = 8, \
+            tr["accepts_new"] - 8
+        errs = check_chunked_obj(obj)
+        assert any("unverified insert" in e for e in errs), errs
+
+    def test_forged_row_served_fails(self):
+        obj = _chunked_obj()
+        leg = obj["arms"]["defended"]["legs"]["forge"]
+        leg["hit"] += 1
+        leg["missing"] -= 1
+        leg["exact"] += 1
+        errs = check_chunked_obj(obj)
+        assert any("forged row entered" in e for e in errs), errs
+
+    def test_no_root_rejects_fails(self):
+        obj = _chunked_obj()
+        obj["arms"]["defended"]["legs"]["forge"]["root_rejects"] = 0
+        errs = check_chunked_obj(obj)
+        assert any("root_rejects" in e for e in errs), errs
+
+    def test_undefended_not_degraded_fails(self):
+        obj = _chunked_obj()
+        legs_u = obj["arms"]["undefended"]["legs"]
+        leg = legs_u["forge"]
+        leg["garbled"], leg["exact"] = 0, leg["hit"]
+        u_hits = sum(lg["hit"] for lg in legs_u.values())
+        obj["arms"]["undefended"]["integrity"] = 1.0
+        obj["bench"]["undefended_integrity"] = 1.0
+        errs = check_chunked_obj(obj)
+        assert any("never bit" in e for e in errs), (errs, u_hits)
+
+    def test_integrity_not_reproducible_fails(self):
+        obj = _chunked_obj()
+        obj["arms"]["undefended"]["integrity"] = 0.5
+        obj["bench"]["undefended_integrity"] = 0.5
+        errs = check_chunked_obj(obj)
+        assert any("reproducible" in e for e in errs), errs
+
+    def test_unhealed_fails(self):
+        obj = _chunked_obj()
+        obj["heal"]["post_hit"] -= 1
+        errs = check_chunked_obj(obj)
+        assert any("re-replicate" in e for e in errs), errs
+
+    def test_torn_missing_rate_fails(self):
+        obj = _chunked_obj()
+        obj["bench"]["torn_missing_rate"] = 0.99
+        errs = check_chunked_obj(obj)
+        assert any("torn_missing_rate" in e for e in errs), errs
+
+    def test_bench_row_gates(self):
+        from opendht_tpu.tools.check_bench import check_bench_rows
+        base = _chunked_obj()["bench"]
+        assert check_bench_rows(dict(base), dict(base)) == []
+        cur = dict(base)
+        cur["garbled_reads"] = 3
+        errs = check_bench_rows(cur, dict(base))
+        assert any("garbled_reads" in e for e in errs), errs
+        cur = dict(base)
+        cur["value"] = 0.999
+        errs = check_bench_rows(cur, dict(base))
+        assert any("!= 1.0" in e for e in errs), errs
+        cur = dict(base)
+        cur["undefended_integrity"] = base["undefended_integrity"] \
+            + 0.2
+        errs = check_bench_rows(cur, dict(base))
+        assert any("injection regressed" in e for e in errs), errs
