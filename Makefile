@@ -158,6 +158,21 @@ gate: lint test
 	python bench.py --mode serve --nodes 16384 --arrival-rate 8000 --duration 3 --serve-slots 1024 --key-pool 1024 --serve-cache 2048 --serve-out /tmp/serve_cache.json
 	python -m opendht_tpu.tools.check_trace /tmp/serve_cache.json
 	python -m opendht_tpu.tools.check_bench /tmp/serve_cache.json BENCH_GATE_r12.json --min-ratio 0.90
+# Round-20 RESIDENT leg: admit -> rounds -> harvest fused into ONE
+# device program (ring admission, double-buffered drain — the burst
+# loop's per-burst readback is gone).  Same 16k/Zipf/cache shape as
+# the r12 leg but offered 10k req/s: the resident engine must sustain
+# >= 1.15x the burst row's 7,580 req/s (recorded: 9,550 req/s, p50
+# 22.5 ms vs the burst leg's 32 ms) with host orchestration < 5 % of
+# the serve wall — check_trace gates the ring conservation identity,
+# depth bounds, and the orchestration share against the budget
+# RECORDED in the artifact (--resident-orch-budget 0.05), so a
+# host-bound regression fails its own file.  The burst legs above are
+# UNCHANGED and still gate vs r07/r12 — that is the A/B: same
+# workload shape, two engines, both walls recorded every gate run.
+	python bench.py --mode serve --nodes 16384 --arrival-rate 10000 --duration 3 --serve-slots 1024 --key-pool 1024 --serve-cache 2048 --serve-engine resident --resident-orch-budget 0.05 --serve-out /tmp/serve_resident.json
+	python -m opendht_tpu.tools.check_trace /tmp/serve_resident.json
+	python -m opendht_tpu.tools.check_bench /tmp/serve_resident.json BENCH_GATE_r17.json --min-ratio 0.90
 # (2) FIRST-CLASS SHARDED serve: the mesh engine (routed per-round
 # exchanges, replicated cache) driven open-loop at 65k nodes on the
 # 8-device virtual mesh, gated vs BENCH_GATE_r12_sharded.json (0.90

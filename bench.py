@@ -259,6 +259,40 @@ def main():
     ap.add_argument("--serve-burst", type=int, default=2,
                     help="serve mode: rounds dispatched between "
                          "admission/harvest syncs")
+    ap.add_argument("--serve-engine", choices=("burst", "resident"),
+                    default="burst",
+                    help="serve mode: loop architecture — 'burst' is "
+                         "the per-burst admit/step/harvest host loop; "
+                         "'resident' fuses admit→rounds→harvest into "
+                         "ONE device program per macro step with a "
+                         "device admission ring, drained double-"
+                         "buffered (the steady state's only host sync "
+                         "overlaps the next macro's compute)")
+    ap.add_argument("--resident-rounds", type=int, default=2,
+                    help="resident engine: rounds per macro step (the "
+                         "resident analogue of --serve-burst; the "
+                         "in-jit loop early-exits when every slot "
+                         "drains, so overshoot is cheap)")
+    ap.add_argument("--ring-slots", type=int, default=0,
+                    help="resident engine: device admission-ring rows "
+                         "(0 = the engine default, 4 x admit cap; "
+                         "must be >= 2 x admit cap)")
+    ap.add_argument("--resident-orch-budget", type=float, default=1.0,
+                    help="resident engine: host-orchestration budget "
+                         "recorded in the artifact — check_trace "
+                         "fails the run if the host share of the "
+                         "serve wall exceeds it (the gate legs pass "
+                         "0.05; the default 1.0 records without "
+                         "gating, for smoke shapes where a trickle "
+                         "arrival rate is host-dominated by "
+                         "construction)")
+    ap.add_argument("--rung-select", type=int, default=0,
+                    help="resident engine: in-jit width-ladder rung "
+                         "block (0 = off — full-width merges; e.g. 8 "
+                         "re-measures the PR-14 switch verdict INSIDE "
+                         "the resident loop, where per-round host "
+                         "dispatch no longer applies; bit-identical "
+                         "results either way)")
     ap.add_argument("--slo-ms", type=float, default=250.0,
                     help="serve mode: per-request latency SLO target "
                          "for the gauge set (milliseconds)")
@@ -3483,9 +3517,10 @@ def serve_main(args):
     histogram⇄row consistency, quantiles inside their buckets).
     """
     from opendht_tpu.models.serve import (
-        AdmissionControl, ServeEngine, ServeOverloadError,
+        AdmissionControl, ResidentServeEngine, ServeEngine,
+        ServeOverloadError, ShardedResidentServeEngine,
         ShardedServeEngine, autotune_serve_slots, measure_round_wall,
-        poisson_zipf_events, serve_open_loop,
+        poisson_zipf_events, serve_open_loop, serve_resident,
     )
     from opendht_tpu.models.swarm import (SwarmConfig, build_swarm,
                                           burst_schedule)
@@ -3537,17 +3572,37 @@ def serve_main(args):
     else:
         slots_mode = "fixed"
 
+    resident = args.serve_engine == "resident"
+    res_kw = dict(
+        ring_slots=args.ring_slots or None,
+        rounds_per_iter=args.resident_rounds)
     if args.sharded:
         from opendht_tpu.parallel import make_mesh
         n_dev = len(jax.devices())
         mesh = make_mesh(n_dev)
-        engine = ShardedServeEngine(
-            swarm, cfg, slots=args.serve_slots, mesh=mesh,
-            capacity_factor=2.0, cache_slots=args.serve_cache)
+        if resident:
+            if args.rung_select:
+                print("bench: --rung-select is local-engine only (the "
+                      "routed step prices its own exchange); ignored "
+                      "under --sharded", file=sys.stderr)
+            engine = ShardedResidentServeEngine(
+                swarm, cfg, args.serve_slots, mesh,
+                capacity_factor=2.0, cache_slots=args.serve_cache,
+                **res_kw)
+        else:
+            engine = ShardedServeEngine(
+                swarm, cfg, slots=args.serve_slots, mesh=mesh,
+                capacity_factor=2.0, cache_slots=args.serve_cache)
     else:
         n_dev = 1
-        engine = ServeEngine(swarm, cfg, slots=args.serve_slots,
-                             cache_slots=args.serve_cache)
+        if resident:
+            engine = ResidentServeEngine(
+                swarm, cfg, slots=args.serve_slots,
+                cache_slots=args.serve_cache,
+                rung_block=args.rung_select or None, **res_kw)
+        else:
+            engine = ServeEngine(swarm, cfg, slots=args.serve_slots,
+                                 cache_slots=args.serve_cache)
     admission = None
     if args.admission != "none":
         admission = AdmissionControl(rate=args.admit_rate,
@@ -3556,10 +3611,17 @@ def serve_main(args):
                                      per_key_rate=args.admit_key_rate,
                                      max_keys=args.admit_max_keys)
     try:
-        rep = serve_open_loop(engine, ts, keys, jax.random.PRNGKey(3),
-                              klass=klass, burst=args.serve_burst,
-                              duration=args.duration,
-                              admission=admission)
+        if resident:
+            rep = serve_resident(
+                engine, ts, keys, jax.random.PRNGKey(3), klass=klass,
+                duration=args.duration, admission=admission,
+                host_orchestration_budget=args.resident_orch_budget)
+        else:
+            rep = serve_open_loop(engine, ts, keys,
+                                  jax.random.PRNGKey(3),
+                                  klass=klass, burst=args.serve_burst,
+                                  duration=args.duration,
+                                  admission=admission)
     except ServeOverloadError as e:
         print(f"bench: {e}", file=sys.stderr)
         sys.exit(2)
@@ -3622,6 +3684,7 @@ def serve_main(args):
         "in_flight": rep["in_flight"],
         "shed": rep["shed"],
         "sharded": bool(args.sharded),
+        "serve_engine": args.serve_engine,
         "n_devices": n_dev,
         "serve_slots_mode": slots_mode,
         "round_wall_probe_s": (round(round_wall_probe, 6)
@@ -3660,6 +3723,21 @@ def serve_main(args):
         "key_pool": args.key_pool,
         "platform": jax.devices()[0].platform,
     }
+    if resident:
+        from opendht_tpu.obs.timeline import (ResidentPlane,
+                                              resident_summary)
+        ResidentPlane(registry).publish_run(rep)
+        rs = resident_summary(rep)
+        out["resident"] = {
+            "host_orchestration_frac":
+                round(rs["host_orchestration_frac"], 6),
+            "overlap_frac": round(rs["overlap_frac"], 6),
+            "iterations": rs["iterations"],
+            "device_rounds": rs["device_rounds"],
+            "ring_shed": rs["ring_shed"],
+            "rung_select": rs["rung_select"],
+            "exchange_mb": round(rs["exchange_mb"], 3),
+        }
     if args.serve_out:
         per_class = {}
         for cls in sorted(set(map(str, rep["klass"]))):
@@ -3693,6 +3771,12 @@ def serve_main(args):
                             for r, w in rep["burst_marks"]],
             "metrics_prometheus": registry.render_prometheus(),
         }
+        if resident:
+            # The resident block the checker's resident leg gates:
+            # ring conservation, depth bounds, orchestration share
+            # vs the recorded budget, in-jit rung counts.
+            obj["resident"] = dict(rep["resident"],
+                                   summary=resident_summary(rep))
         if rep["cache_slots"]:
             # Cache block: hit/miss accounting plus the hit SERVICE-
             # rounds histogram — a hit completes in zero lookup

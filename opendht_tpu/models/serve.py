@@ -65,7 +65,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.xor_metric import N_LIMBS
+from ..ops.xor_metric import N_LIMBS, merge_ladder_widths
 from ..utils.hostdevice import dev_i32
 from . import swarm as _swarm
 from .swarm import (
@@ -79,6 +79,7 @@ from .swarm import (
     _sample_origins,
     burst_schedule,
     init_impl,
+    step_impl,
 )
 
 
@@ -482,6 +483,10 @@ class ShardedServeEngine(ServeEngine):
             raise ValueError(f"serve slots {slots} and admit_cap "
                              f"{self.admit_cap} must divide the "
                              f"{d}-device mesh")
+        # Routed-exchange row counter (observability): init rows that
+        # actually rode the all_to_all — cache hits are excluded by
+        # the masked init, which is the provable mesh-hit skip.
+        self.xchg_init_rows = 0
 
     def admit(self, st, keys, slots, key, rnd):
         # Routed seed exchange (shard-local origin folding inside the
@@ -492,14 +497,24 @@ class ShardedServeEngine(ServeEngine):
         return _scatter_admission(st, new, slots, dev_i32(rnd))
 
     def admit_probed(self, st, keys, slots, key, rnd):
-        # Same routed init; the probe rides the scatter program
-        # (_scatter_admission_cached) against the REPLICATED cache.
-        from ..parallel.sharded import _sharded_lookup_init
-        new = _sharded_lookup_init(self.swarm, self.cfg, keys, key,
-                                   self.mesh, self.capacity_factor)
-        st, self.cache, hit, found, hops = _scatter_admission_cached(
-            st, self.cache, new, slots, dev_i32(rnd))
+        # Cache-AWARE sharded admission (round 20): the replicated
+        # cache is probed BEFORE the routed init and hit rows are
+        # handed to the init as its skip mask, so a mesh hit never
+        # rides the ``all_to_all`` (previously hit rows ran the full
+        # routed seed exchange and were only dropped at the scatter).
+        # Same sync count as before: ONE small readback per
+        # admission, now of the standalone probe.  Non-hit rows'
+        # init is bit-identical (the masked body's full-width origin
+        # draw), so the admitted state is unchanged.
+        from ..parallel.sharded import _sharded_lookup_init_masked
+        hit, found, hops = _cache_probe(self.cache, keys)
+        new = _sharded_lookup_init_masked(
+            self.swarm, self.cfg, keys, key, hit, self.mesh,
+            self.capacity_factor)
+        st = _scatter_admission_masked(st, new, slots, hit,
+                                       dev_i32(rnd))
         h, f, hp = jax.device_get((hit, found, hops))
+        self.xchg_init_rows += int(keys.shape[0] - h.sum())
         return st, h, f, hp
 
     def step(self, st, rnd):
@@ -515,24 +530,22 @@ def _scatter_admission(st: LookupState, new: LookupState,
     return _scatter_rows_into(st, new, slots, rnd)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _scatter_admission_cached(st: LookupState, cache: ResultCache,
-                              new: LookupState, slots: jax.Array,
-                              rnd: jax.Array):
-    """The sharded twin of :func:`_admit_cached`: the routed init
-    already ran (``_sharded_lookup_init`` — its seed exchange must
-    stay uncapped and shard-local), so the probe keys are the init
-    rows' own ``targets``.  The cache is REPLICATED across the mesh
-    like the trace's pmax fields: fills are computed from replicated
-    host-side inputs (every device runs the same fill on the same
-    data), so no psum is needed to keep the copies identical — GSPMD
-    gathers the sharded probe indices against the replicated cache
-    and the hit row comes back replicated."""
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_admission_masked(st: LookupState, new: LookupState,
+                              slots: jax.Array, skip: jax.Array,
+                              rnd: jax.Array) -> LookupState:
+    """Sharded cached-admission scatter, round-20 form: the probe now
+    runs STANDALONE before the routed init (so hit rows can be
+    masked out of the ``all_to_all`` — see
+    ``ShardedServeEngine.admit_probed``), and this scatter only has
+    to drop the skipped rows to the sentinel.  Replaces the retired
+    ``_scatter_admission_cached``, whose probe-in-scatter form forced
+    every hit row through the routed seed exchange first.  The cache
+    stays REPLICATED across the mesh exactly as before (fills come
+    from replicated host-side inputs, so the copies never diverge)."""
     c = st.done.shape[0]
-    hit, h_found, h_hops = _probe_impl(cache, new.targets)
-    eff = jnp.where(hit, jnp.int32(c), slots)
-    st = _scatter_rows_into(st, new, eff, rnd)
-    return st, cache, hit, h_found, h_hops
+    eff = jnp.where(skip, jnp.int32(c), slots)
+    return _scatter_rows_into(st, new, eff, rnd)
 
 
 def poisson_zipf_events(rate: float, duration: float, key_pool: int,
@@ -1255,13 +1268,957 @@ def closed_loop_replay(swarm: Swarm, cfg: SwarmConfig,
         # Per-BURST done poll (explicit device_get: bool() on a device
         # array is an implicit D2H transfer, forbidden under the
         # strict transfer-guard replay).
-        # graftlint: disable=sync-in-loop (per-burst done-check readback, amortized over >=2 device rounds — same contract as the burst loops')
+        # graftlint: disable=sync-in-loop (per-burst done-check readback, amortized over >=2 device rounds — the BURST replay's contract; resident_closed_loop_replay runs the same workload with zero in-loop polls)
         if bool(jax.device_get(jnp.all(st.done))):
             break
         burst = 2
     res = LookupResult(found=_finalize(swarm.ids, st, cfg),
                        hops=st.hops, done=st.done)
     return res, st
+
+
+# ---------------------------------------------------------------------------
+# device-resident serve loop (ISSUE 20)
+# ---------------------------------------------------------------------------
+#
+# The burst engines above still pay one host round-trip PER BURST (the
+# ``engine.snapshot`` harvest readback), and PR 14's negative result
+# measured exactly that cost: 1-round bursts ran 13 % slower because
+# host dispatch serializes against device execution.  The resident
+# loop is the reference's single-threaded event loop
+# (include/opendht/scheduler.h:38-123) rebuilt as ONE device program:
+# admit → rounds → harvest fused into a single jit whose admission
+# rides a device ring buffer the host fills ahead of time and whose
+# completions come back as one bulk output the host drains one macro
+# step LATER (double-buffered: macro k+1 is dispatched before macro
+# k's output is read, so the only host sync in the steady state — the
+# ``device_get`` of the PREVIOUS step's output — overlaps the current
+# step's device compute instead of serializing against it).
+#
+# Ring contract (all device-side, scanned by ``_ring_enqueue`` /
+# ``_ring_pop`` inside the resident program):
+#
+# * ``rq_*[R]`` is a circular request queue; ``head``/``tail`` are
+#   MONOTONIC i32 counters (positions are taken mod R), so
+#   ``tail - head`` is the backlog and fullness needs no wrap flag.
+# * The host may enqueue at most ``R - backlog`` rows per step;
+#   overflow rows are counted in ``shed`` and dropped (the open-loop
+#   driver throttles hand-off so this stays 0 — excess waits in the
+#   HOST queue under the overload guard, never silently on device).
+# * Admission pops ``min(backlog, free slots, admit_cap)`` rows into
+#   the LOWEST free slots (a stable argsort over the free mask — the
+#   deterministic order the closed-loop replay identity leans on),
+#   seeds them with the batch engine's ``init_impl`` exchange, and
+#   stamps ``slot_req`` so completions can be attributed without any
+#   host-side slot bookkeeping.
+# * Completions drain through the bulk ``ResidentOut`` rows exactly
+#   once: the step frees a completed slot (``admitted_round = -1``)
+#   in the same program that reported it.
+
+class ServeRings(NamedTuple):
+    """Device-resident admission ring + slot attribution (a pytree).
+
+    ``rq_keys [R,5]`` / ``rq_req [R]`` / ``rq_cls [R]`` — the circular
+    request queue (key limbs, host request index, work class;
+    ``rq_req = -1`` means never-written).  ``head``/``tail``/``shed``
+    — monotonic pop/accept/overflow counters.  ``slot_req [C]`` /
+    ``slot_cls [C]`` — which request currently owns each lookup slot
+    (-1 free), the device twin of the burst loop's host ``occupied``
+    dict."""
+    rq_keys: jax.Array
+    rq_req: jax.Array
+    rq_cls: jax.Array
+    head: jax.Array
+    tail: jax.Array
+    shed: jax.Array
+    slot_req: jax.Array
+    slot_cls: jax.Array
+
+
+class ResidentOut(NamedTuple):
+    """Bulk per-macro-step output of the resident program — the ONE
+    readback the host drains (one macro step late).
+
+    Scalars: ``adm``/``hits`` rows admitted / answered from cache this
+    step, ``queued`` ring backlog after admission, ``head``/``tail``/
+    ``shed`` the ring's monotonic counters, ``rounds_run`` actual
+    while-loop trips (early exit when everything drains).
+    ``hit*`` rows are admission-width ``[A]``: cache hits answered at
+    pop time without ever occupying a slot.  ``comp*`` rows are
+    slot-width ``[C]``: slots that finished (or expired,
+    ``comp_com = -1``) during this step — drained exactly once, the
+    program frees them after reporting.  ``rung_counts`` are the
+    in-jit width-ladder selections (``[1]`` when the ladder is off);
+    ``xchg_*_rows`` count routed-exchange rows on the sharded engine
+    (0 locally) — the counter that proves mesh cache hits skip the
+    ``all_to_all``."""
+    adm: jax.Array
+    hits: jax.Array
+    queued: jax.Array
+    head: jax.Array
+    tail: jax.Array
+    shed: jax.Array
+    rounds_run: jax.Array
+    hit: jax.Array
+    hit_req: jax.Array
+    hit_found: jax.Array
+    hit_hops: jax.Array
+    comp: jax.Array
+    comp_req: jax.Array
+    comp_cls: jax.Array
+    comp_hops: jax.Array
+    comp_adm: jax.Array
+    comp_com: jax.Array
+    comp_found: jax.Array
+    rung_counts: jax.Array
+    xchg_init_rows: jax.Array
+    xchg_round_rows: jax.Array
+
+
+@partial(jax.jit, static_argnames=("slots", "ring_slots"))
+def empty_serve_rings(slots: int, ring_slots: int) -> ServeRings:
+    """All-empty rings: zero backlog, every slot unattributed."""
+    return ServeRings(
+        rq_keys=jnp.zeros((ring_slots, N_LIMBS), jnp.uint32),
+        rq_req=jnp.full((ring_slots,), -1, jnp.int32),
+        rq_cls=jnp.full((ring_slots,), -1, jnp.int32),
+        head=jnp.int32(0),
+        tail=jnp.int32(0),
+        shed=jnp.int32(0),
+        slot_req=jnp.full((slots,), -1, jnp.int32),
+        slot_cls=jnp.full((slots,), -1, jnp.int32))
+
+
+def _ring_enqueue(rings: ServeRings, keys: jax.Array, reqs: jax.Array,
+                  cls: jax.Array, n_new: jax.Array) -> ServeRings:
+    """Accept ``n_new`` (≤ admission width) rows into the ring.
+
+    Rows past the ring's free space are SHED (counted, dropped) —
+    full-ring backpressure is explicit, never a silent overwrite of
+    queued work.  Traced inside the resident jits."""
+    a = keys.shape[0]
+    r = rings.rq_keys.shape[0]
+    n_new = jnp.clip(jnp.asarray(n_new, jnp.int32), 0, a)
+    space = jnp.int32(r) - (rings.tail - rings.head)
+    n_in = jnp.minimum(n_new, space)
+    j = jnp.arange(a, dtype=jnp.int32)
+    qpos = jnp.where(j < n_in, (rings.tail + j) % jnp.int32(r),
+                     jnp.int32(r))
+    return rings._replace(
+        rq_keys=rings.rq_keys.at[qpos].set(keys, mode="drop"),
+        rq_req=rings.rq_req.at[qpos].set(reqs, mode="drop"),
+        rq_cls=rings.rq_cls.at[qpos].set(cls, mode="drop"),
+        tail=rings.tail + n_in,
+        shed=rings.shed + (n_new - n_in))
+
+
+def _ring_pop(st: LookupState, rings: ServeRings, a: int):
+    """Pop up to ``a`` queued rows and pair them with free slots.
+
+    A slot is FREE iff ``done & admitted_round < 0`` (the engines'
+    invariant).  Free slots are taken LOWEST-INDEX-FIRST via a stable
+    argsort over the free mask — on an all-free state slot j serves
+    popped row j, which is what makes the closed-loop replay
+    bit-identical to the batch engine's row order.  Returns
+    ``(rings, pkeys [a,5], preq [a], pcls [a], cand [a], valid [a])``
+    with the ring head already advanced; rows ``j >= p`` are padding
+    (``valid`` False, ``preq = -1``)."""
+    c = st.done.shape[0]
+    r = rings.rq_keys.shape[0]
+    free = st.done & (st.admitted_round < 0)
+    n_free = jnp.sum(free.astype(jnp.int32))
+    backlog = rings.tail - rings.head
+    p = jnp.minimum(jnp.minimum(backlog, n_free), jnp.int32(a))
+    j = jnp.arange(a, dtype=jnp.int32)
+    valid = j < p
+    rpos = (rings.head + j) % jnp.int32(r)
+    pkeys = rings.rq_keys[rpos]
+    preq = jnp.where(valid, rings.rq_req[rpos], -1)
+    pcls = jnp.where(valid, rings.rq_cls[rpos], -1)
+    order = jnp.argsort(~free, stable=True).astype(jnp.int32)
+    cand = order[jnp.clip(j, 0, c - 1)]
+    return (rings._replace(head=rings.head + p), pkeys, preq, pcls,
+            cand, valid)
+
+
+def _cache_fill_sorted(cache: ResultCache, keys: jax.Array,
+                       found: jax.Array, hops: jax.Array,
+                       mask: jax.Array, rnd: jax.Array) -> ResultCache:
+    """In-jit cache fill with DEVICE-side slot dedup (the resident
+    twin of ``fill_cache``'s host dedup): one stable sort groups rows
+    by cache slot and the LAST row of each group wins, so the five
+    per-field scatters see unique indices — the
+    :func:`_cache_fill`-documented mixed-winner hazard cannot occur.
+    Masked rows sort to the drop sentinel."""
+    k_slots = cache.keys.shape[0]
+    m = keys.shape[0]
+    cs = jnp.where(mask, _cache_slot_of(keys, k_slots),
+                   jnp.int32(k_slots))
+    rows = jnp.arange(m, dtype=jnp.int32)
+    cs_s, row_s = jax.lax.sort((cs, rows), dimension=0, num_keys=1,
+                               is_stable=True)
+    last = jnp.concatenate([cs_s[1:] != cs_s[:-1],
+                            jnp.ones((1,), bool)])
+    eff = jnp.where(last & (cs_s < k_slots), cs_s, jnp.int32(k_slots))
+    ep = jnp.broadcast_to(cache.epoch, eff.shape)
+    r32 = jnp.broadcast_to(jnp.asarray(rnd, jnp.int32), eff.shape)
+    return cache._replace(
+        keys=cache.keys.at[eff].set(keys[row_s], mode="drop"),
+        found=cache.found.at[eff].set(found[row_s], mode="drop"),
+        hops=cache.hops.at[eff].set(hops[row_s], mode="drop"),
+        fill_round=cache.fill_round.at[eff].set(r32, mode="drop"),
+        fill_epoch=cache.fill_epoch.at[eff].set(ep, mode="drop"))
+
+
+def _resident_rounds(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+                     rnd0: jax.Array, rounds: int,
+                     rung_block: int | None):
+    """The fused round loop: ``rounds`` lock-step rounds as ONE
+    ``lax.while_loop`` with on-device early exit (everything-done
+    states stop paying round dispatches — no host poll involved).
+
+    ``rung_block`` folds PR 14's width ladder in as IN-JIT rung
+    selection: the ladder's ``wneed`` watermark (the exact
+    ``_pending_and_wneed`` formula) is recomputed on device each
+    round and a ``lax.switch`` picks the narrowest
+    ``rank_merge_round_d0_w`` rung covering it — bit-identical to the
+    full-width merge by the rung guard, so this is purely a pricing
+    decision.  PR 14 measured the switch 2.5× SLOWER than host-side
+    rung selection on XLA:CPU when each round was its own dispatch;
+    inside the resident loop the host-dispatch rationale is gone, so
+    the verdict is re-measured here (BASELINE.md).  Returns
+    ``(state, rounds_run, rung_counts)``."""
+    if _swarm.resolve_merge_impl(cfg) == "pallas-round":
+        if rung_block is not None:
+            raise ValueError(
+                "rung_block width selection needs the XLA rank merge; "
+                "merge_impl='pallas-round' fuses its own fixed-width "
+                "merge — drop one of the two")
+
+        def one_round(st, rnd):
+            return _swarm._fused_round_step(swarm, cfg, st, rnd=rnd), 0
+        n_rungs = 1
+    elif rung_block is None:
+        def one_round(st, rnd):
+            return step_impl(swarm.ids, swarm.alive,
+                             _local_respond(swarm, cfg), cfg, st,
+                             rnd=rnd), 0
+        n_rungs = 1
+    else:
+        full_w = cfg.alpha * 2 * cfg.bucket_k
+        rungs = merge_ladder_widths(full_w, rung_block)
+        thresholds = jnp.asarray(rungs, jnp.int32)
+        n_rungs = len(rungs)
+
+        def _branch(w):
+            mw = None if w >= full_w else w
+
+            def run(st, rnd):
+                return step_impl(swarm.ids, swarm.alive,
+                                 _local_respond(swarm, cfg), cfg, st,
+                                 rnd=rnd, merge_w=mw)
+            return run
+        branches = [_branch(w) for w in rungs]
+
+        def one_round(st, rnd):
+            # In-jit wneed: the widest pending row's solicitation
+            # width (mirrors _pending_and_wneed without the readback).
+            unq = jnp.sum((st.idx >= 0) & ~st.queried, axis=1)
+            blocks = jnp.where(st.done, 0,
+                               jnp.minimum(cfg.alpha, unq))
+            wneed = jnp.max(blocks) * (2 * cfg.bucket_k)
+            bi = jnp.clip(
+                jnp.searchsorted(thresholds, wneed, side="left"),
+                0, n_rungs - 1).astype(jnp.int32)
+            return jax.lax.switch(bi, branches, st, rnd), bi
+
+    def cond(carry):
+        st, it, _counts = carry
+        return (it < jnp.int32(rounds)) & jnp.any(~st.done)
+
+    def body(carry):
+        st, it, counts = carry
+        st, bi = one_round(st, rnd0 + it)
+        return st, it + 1, counts.at[bi].add(1)
+
+    st, it, counts = jax.lax.while_loop(
+        cond, body,
+        (st, jnp.int32(0), jnp.zeros((n_rungs,), jnp.int32)))
+    return st, it, counts
+
+
+def _resident_tail(ids: jax.Array, cfg: SwarmConfig, st: LookupState,
+                   rings: ServeRings, cache: ResultCache | None,
+                   rnd_end: jax.Array, expire: bool):
+    """Shared harvest tail of the resident programs (local and
+    sharded): in-jit expiry, completion detection, finalize, in-jit
+    cache fill, and slot freeing — the completed rows drain exactly
+    once because the SAME program that reports them frees them.
+    Returns ``(st, rings, cache, comp, fin)``."""
+    if expire:
+        stale = (~st.done) & (st.admitted_round >= 0) \
+            & (rnd_end - st.admitted_round >= cfg.max_steps)
+        st = st._replace(done=st.done | stale)
+    comp = st.done & (st.admitted_round >= 0)
+    fin = _finalize(ids, st, cfg)
+    if cache is not None:
+        # Fill only true completions with non-empty heads — never
+        # expired rows, never negatives (the fill_cache contract).
+        fmask = comp & (st.completed_round >= 0) & (fin[:, 0] >= 0)
+        cache = _cache_fill_sorted(cache, st.targets, fin, st.hops,
+                                   fmask, rnd_end)
+    return st, rings, cache, comp, fin
+
+
+def _resident_core(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+                   rings: ServeRings, cache: ResultCache | None,
+                   keys: jax.Array, reqs: jax.Array, cls: jax.Array,
+                   key: jax.Array, n_new: jax.Array, rnd0: jax.Array,
+                   rounds: int, expire: bool, rung_block: int | None):
+    """The local resident macro step: enqueue → pop/probe/admit →
+    fused rounds → harvest/fill/free, ONE program end to end."""
+    c = st.done.shape[0]
+    a = keys.shape[0]
+    rings = _ring_enqueue(rings, keys, reqs, cls, n_new)
+    rings, pkeys, preq, pcls, cand, valid = _ring_pop(st, rings, a)
+    if cache is not None:
+        hit_raw, h_found, h_hops = _probe_impl(cache, pkeys)
+        hit = hit_raw & valid
+    else:
+        hit = jnp.zeros((a,), bool)
+        h_found = jnp.full((a, cfg.quorum), -1, jnp.int32)
+        h_hops = jnp.zeros((a,), jnp.int32)
+    take = valid & ~hit
+    # Full-width origin draw with the caller's key DIRECTLY — the
+    # replay identity needs this to match the batch engine's
+    # ``_sample_origins(key, alive, l)`` bit-for-bit; non-admitted
+    # rows' init results are dropped by the sentinel scatter exactly
+    # like ``_admit_cached``'s hit rows.
+    origins = _sample_origins(key, swarm.alive, a)
+    eff = jnp.where(take, cand, jnp.int32(c))
+    new = init_impl(swarm.ids, _local_respond(swarm, cfg), cfg, pkeys,
+                    origins)
+    st = _scatter_rows_into(st, new, eff, rnd0)
+    rings = rings._replace(
+        slot_req=rings.slot_req.at[eff].set(preq, mode="drop"),
+        slot_cls=rings.slot_cls.at[eff].set(pcls, mode="drop"))
+    st, rounds_run, rung_counts = _resident_rounds(
+        swarm, cfg, st, rnd0, rounds, rung_block)
+    rnd_end = rnd0 + jnp.int32(rounds)
+    st, rings, cache, comp, fin = _resident_tail(
+        swarm.ids, cfg, st, rings, cache, rnd_end, expire)
+    out = ResidentOut(
+        adm=jnp.sum(take.astype(jnp.int32)),
+        hits=jnp.sum(hit.astype(jnp.int32)),
+        queued=rings.tail - rings.head,
+        head=rings.head, tail=rings.tail, shed=rings.shed,
+        rounds_run=rounds_run,
+        hit=hit,
+        hit_req=jnp.where(hit, preq, -1),
+        hit_found=h_found, hit_hops=h_hops,
+        comp=comp,
+        comp_req=jnp.where(comp, rings.slot_req, -1),
+        comp_cls=jnp.where(comp, rings.slot_cls, -1),
+        comp_hops=st.hops,
+        comp_adm=st.admitted_round,
+        comp_com=st.completed_round,
+        comp_found=fin,
+        rung_counts=rung_counts,
+        xchg_init_rows=jnp.int32(0),
+        xchg_round_rows=jnp.int32(0))
+    # Free the reported slots: done stays True, lifecycle clears —
+    # the FREE invariant — and attribution clears with it.
+    st = st._replace(
+        admitted_round=jnp.where(comp, -1, st.admitted_round))
+    rings = rings._replace(
+        slot_req=jnp.where(comp, -1, rings.slot_req),
+        slot_cls=jnp.where(comp, -1, rings.slot_cls))
+    return st, rings, cache, out
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "rounds", "expire", "rung_block"),
+         donate_argnums=(2, 3))
+def _resident_step(swarm: Swarm, cfg: SwarmConfig, st: LookupState,
+                   rings: ServeRings, keys: jax.Array,
+                   reqs: jax.Array, cls: jax.Array, key: jax.Array,
+                   n_new: jax.Array, rnd0: jax.Array, *, rounds: int,
+                   expire: bool = True,
+                   rung_block: int | None = None):
+    """Cache-off resident macro step (state + rings donated — the
+    resident carries are single-owner like the burst loops')."""
+    st, rings, _cache, out = _resident_core(
+        swarm, cfg, st, rings, None, keys, reqs, cls, key, n_new,
+        rnd0, rounds, expire, rung_block)
+    return st, rings, out
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "rounds", "expire", "rung_block"),
+         donate_argnums=(2, 3, 4))
+def _resident_step_cached(swarm: Swarm, cfg: SwarmConfig,
+                          st: LookupState, rings: ServeRings,
+                          cache: ResultCache, keys: jax.Array,
+                          reqs: jax.Array, cls: jax.Array,
+                          key: jax.Array, n_new: jax.Array,
+                          rnd0: jax.Array, *, rounds: int,
+                          expire: bool = True,
+                          rung_block: int | None = None):
+    """Resident macro step with the ResultCache riding INSIDE the
+    program: pop-time probe (a hit never occupies a slot and its
+    answer never leaves the device until the bulk drain) and
+    harvest-time fill with device-side slot dedup
+    (:func:`_cache_fill_sorted`) — no per-admission host sync at all,
+    unlike the burst engines' ``admit_probed``."""
+    st, rings, cache, out = _resident_core(
+        swarm, cfg, st, rings, cache, keys, reqs, cls, key, n_new,
+        rnd0, rounds, expire, rung_block)
+    return st, rings, cache, out
+
+
+class ResidentServeEngine(ServeEngine):
+    """Serve engine whose whole iteration is ONE device program
+    (:func:`_resident_step`/``_cached``): the host only fills the
+    admission ring and drains the bulk output, one macro step late.
+
+    ``ring_slots`` (default ``4 × admit_cap``) sizes the device
+    admission ring; it must be ≥ ``2 × admit_cap`` so the open-loop
+    driver's hand-off throttle (which holds back up to one in-flight
+    enqueue batch of uncertainty) can always prove space and device
+    sheds stay 0.  ``rounds_per_iter`` is the macro step's round
+    budget (the resident analogue of the burst width — the loop early-
+    exits on device when everything drains, so overshoot is cheap).
+    ``rung_block`` turns on in-jit width-ladder rung selection (see
+    :func:`_resident_rounds`); incompatible with
+    ``merge_impl='pallas-round'``."""
+
+    def __init__(self, swarm: Swarm, cfg: SwarmConfig, slots: int,
+                 admit_cap: int | None = None, cache_slots: int = 0,
+                 ring_slots: int | None = None,
+                 rounds_per_iter: int = 2,
+                 rung_block: int | None = None):
+        super().__init__(swarm, cfg, slots, admit_cap,
+                         cache_slots=cache_slots)
+        self.ring_slots = ring_slots or 4 * self.admit_cap
+        if self.ring_slots < 2 * self.admit_cap:
+            raise ValueError(
+                f"ring_slots {self.ring_slots} must be >= 2 x "
+                f"admit_cap {self.admit_cap}: the host throttle "
+                f"reserves one in-flight enqueue batch of headroom")
+        if rounds_per_iter < 1:
+            raise ValueError("rounds_per_iter must be >= 1")
+        self.rounds_per_iter = rounds_per_iter
+        if rung_block is not None \
+                and _swarm.resolve_merge_impl(cfg) == "pallas-round":
+            raise ValueError(
+                "rung_block width selection needs the XLA rank "
+                "merge; merge_impl='pallas-round' fuses its own "
+                "fixed-width merge — drop one of the two")
+        self.rung_block = rung_block
+
+    def empty_rings(self) -> ServeRings:
+        return empty_serve_rings(self.slots, self.ring_slots)
+
+    def macro_step(self, st, rings, keys, reqs, cls, key, n_new, rnd0,
+                   rounds: int | None = None, expire: bool = True,
+                   use_cache: bool | None = None):
+        """One resident macro step.  ``keys/reqs/cls`` are the padded
+        ``[admit_cap]``-wide enqueue batch (``n_new`` real rows);
+        returns ``(st, rings, out)`` with NOTHING synced — the caller
+        drains ``out`` whenever it likes (the double buffer)."""
+        rounds = self.rounds_per_iter if rounds is None else rounds
+        if use_cache is None:
+            use_cache = self.cache is not None
+        if use_cache:
+            if self.cache is None:
+                raise ValueError("use_cache=True needs cache_slots>0")
+            st, rings, self.cache, out = _resident_step_cached(
+                self.swarm, self.cfg, st, rings, self.cache, keys,
+                reqs, cls, key, dev_i32(n_new), dev_i32(rnd0),
+                rounds=rounds, expire=expire,
+                rung_block=self.rung_block)
+        else:
+            st, rings, out = _resident_step(
+                self.swarm, self.cfg, st, rings, keys, reqs, cls, key,
+                dev_i32(n_new), dev_i32(rnd0), rounds=rounds,
+                expire=expire, rung_block=self.rung_block)
+        return st, rings, out
+
+    def warm_resident(self, rounds: int | None = None) -> None:
+        """Compile the macro program off the clock on throwaway
+        carries (same shapes, zero work — nothing queued)."""
+        a = self.admit_cap
+        st = self.empty()
+        rings = self.empty_rings()
+        keys = jnp.zeros((a, N_LIMBS), jnp.uint32)
+        reqs = jnp.full((a,), -1, jnp.int32)
+        cls = jnp.full((a,), -1, jnp.int32)
+        st, rings, out = self.macro_step(
+            st, rings, keys, reqs, cls, jax.random.PRNGKey(0), 0, 0,
+            rounds=rounds)
+        jax.block_until_ready(out)
+
+
+class ShardedResidentServeEngine(ResidentServeEngine):
+    """Mesh resident engine: the macro step is
+    :func:`opendht_tpu.parallel.sharded._sharded_resident_step` —
+    rings and cache replicated, state sharded, the round loop a
+    psum-synchronised ``while_loop`` under ``shard_map``, and the
+    cache probed BEFORE the routed init so mesh hits never ride the
+    ``all_to_all`` (``out.xchg_init_rows`` proves it).  No
+    ``rung_block`` (the routed step prices its own exchange)."""
+
+    def __init__(self, swarm: Swarm, cfg: SwarmConfig, slots: int,
+                 mesh, capacity_factor: float = 2.0,
+                 admit_cap: int | None = None, cache_slots: int = 0,
+                 ring_slots: int | None = None,
+                 rounds_per_iter: int = 2):
+        super().__init__(swarm, cfg, slots, admit_cap,
+                         cache_slots=cache_slots,
+                         ring_slots=ring_slots,
+                         rounds_per_iter=rounds_per_iter)
+        from ..parallel.mesh import AXIS
+        self.mesh, self.capacity_factor = mesh, capacity_factor
+        d = mesh.shape[AXIS]
+        if slots % d or self.admit_cap % d:
+            raise ValueError(f"serve slots {slots} and admit_cap "
+                             f"{self.admit_cap} must divide the "
+                             f"{d}-device mesh")
+
+    @property
+    def exchange_row_bytes(self) -> int:
+        """Bytes one admission/solicitation row pays on the routed
+        exchange after the slim return leg: an 8-byte query row plus
+        a ``2K``-candidate response row (u16 pair windows and i32
+        index rows both land at ``8·K`` bytes)."""
+        return 8 + 8 * self.cfg.bucket_k
+
+    def macro_step(self, st, rings, keys, reqs, cls, key, n_new, rnd0,
+                   rounds: int | None = None, expire: bool = True,
+                   use_cache: bool | None = None):
+        from ..parallel.sharded import _sharded_resident_step
+        rounds = self.rounds_per_iter if rounds is None else rounds
+        if use_cache is None:
+            use_cache = self.cache is not None
+        if use_cache and self.cache is None:
+            raise ValueError("use_cache=True needs cache_slots>0")
+        cache = self.cache if use_cache else None
+        st, rings, cache, out = _sharded_resident_step(
+            self.swarm, self.cfg, st, rings, cache, keys, reqs, cls,
+            key, dev_i32(n_new), dev_i32(rnd0), self.mesh,
+            self.capacity_factor, rounds=rounds, expire=expire)
+        if use_cache:
+            self.cache = cache
+        return st, rings, out
+
+
+def resident_closed_loop_replay(swarm: Swarm, cfg: SwarmConfig,
+                                targets: jax.Array, key: jax.Array,
+                                engine: ResidentServeEngine | None
+                                = None):
+    """Closed-loop replay through the RESIDENT program: enqueue the
+    whole batch, run one macro step with the full round budget, and
+    read the bulk output — must be bit-identical (found/hops/done) to
+    :func:`closed_loop_replay` and hence to the batch engines, for
+    the same key (asserted in tests/test_serve.py).
+
+    The identity chain: an all-free state pops row j into slot j (the
+    stable argsort), the origin draw is the caller's key direct and
+    full-width (the batch draw), the seed exchange is ``init_impl``,
+    and each while-loop trip is the SAME shared round at the same
+    round index.  ``expire=False`` because the batch engines report
+    stragglers as ``done=False`` instead of retiring them; the replay
+    always runs cache-off (replay semantics are the batch engine's).
+    Returns ``(LookupResult, final state, ResidentOut)``."""
+    l = targets.shape[0]
+    eng = engine if engine is not None \
+        else ResidentServeEngine(swarm, cfg, slots=l, admit_cap=l,
+                                 ring_slots=2 * l)
+    if eng.slots != l or eng.admit_cap < l:
+        raise ValueError(f"resident replay needs slots == L == "
+                         f"admit_cap; engine has slots={eng.slots}, "
+                         f"admit_cap={eng.admit_cap} for L={l}")
+    st = eng.empty()
+    rings = eng.empty_rings()
+    st, rings, out = eng.macro_step(
+        st, rings, jnp.asarray(targets),
+        jnp.arange(l, dtype=jnp.int32), jnp.zeros((l,), jnp.int32),
+        key, l, 0, rounds=cfg.max_steps, expire=False,
+        use_cache=False)
+    res = LookupResult(found=out.comp_found, hops=out.comp_hops,
+                       done=out.comp)
+    return res, st, out
+
+
+def serve_resident(engine: ResidentServeEngine, arrival_ts, keys, key,
+                   klass=None, duration: float | None = None,
+                   overload_queue_factor: int = 8,
+                   drain_round_cap: int | None = None,
+                   clock=None, sleep=None,
+                   admission: AdmissionControl | None = None,
+                   host_orchestration_budget: float = 0.05) -> dict:
+    """Open-loop driver for the resident engine — the double-buffered
+    twin of :func:`serve_open_loop`.
+
+    Each host iteration (1) pulls due arrivals into the host queue,
+    (2) hands at most one padded enqueue batch to the device ring —
+    throttled to ``ring − backlog − admit_cap`` rows so the device
+    ring NEVER sheds (excess waits in the host queue under the same
+    overload guard as the burst loop), (3) dispatches macro step
+    ``k+1``, and only then (4) drains macro step ``k``'s bulk output
+    — the one ``device_get`` in the steady state, which therefore
+    overlaps step ``k+1``'s device compute instead of serializing
+    against it.  Latency is reconstructed exactly like the burst
+    loop's (round-end walls interpolated between macro marks, floored
+    at the request's hand-off wall so queueing delay is counted);
+    marks are stamped when the macro DISPATCH returns — compute end
+    on the synchronous backend, the same instant the burst loop's
+    per-burst sync stamps — not at the double-buffered drain a macro
+    later.
+
+    ``admission`` supports policies ``shed`` and ``queue`` (applied
+    host-side at hand-off); ``degrade`` needs the per-batch host
+    probe the resident loop exists to avoid — build the burst engine
+    for that.  The report is the burst loop's dict plus a
+    ``"resident"`` block (ring counters, host-orchestration share,
+    in-jit rung counts, routed-exchange rows when sharded).
+    ``host_orchestration_frac`` is the wall share that is NEITHER the
+    macro dispatch (device compute runs inline in that call on a
+    synchronous backend), nor the drain's blocked ``device_get``, nor
+    idle sleep — i.e. genuine host bookkeeping;
+    ``host_orchestration_budget`` is recorded alongside for the
+    checker's <5 % gate."""
+    clock = clock or time.perf_counter
+    sleep = sleep or time.sleep
+    cfg, c = engine.cfg, engine.slots
+    a_cap = engine.admit_cap
+    rounds = engine.rounds_per_iter
+    use_cache = engine.cache is not None
+    if admission is not None and admission.policy == "degrade":
+        raise ValueError(
+            "admission policy 'degrade' needs the burst engine's "
+            "host-side cache probe; the resident loop supports "
+            "'shed' and 'queue'")
+    keys = np.asarray(keys)
+    arrival_np = np.asarray(arrival_ts, np.float64)
+    r_total = len(arrival_np)
+    if klass is None:
+        klass = np.full(r_total, "all")
+    drain_cap = drain_round_cap or 4 * cfg.max_steps
+    if duration is None:
+        duration = float(arrival_ts[-1]) if r_total else 0.0
+    hard_wall = duration * 5.0 + 30.0
+
+    engine.warm_resident()
+    st = engine.empty()
+    rings = engine.empty_rings()
+
+    queue: list[int] = []
+    enq_wall = np.zeros(r_total, np.float64)
+    next_ev = 0
+    rnd = 0
+    it_i = 0
+    marks_r = [0]
+    marks_w = [0.0]
+    # Interp window: a completion's round is ≥ rnd_end − max_steps
+    # (expiry retires older rows), so this many trailing marks always
+    # bracket every cr+1 — the tail window keeps the per-drain interp
+    # O(window), not O(run).
+    tw = cfg.max_steps // max(1, rounds) + 4
+    rec_req, rec_lat, rec_hops, rec_rounds, rec_found = \
+        [], [], [], [], []
+    queue_depths: list[int] = []
+    occ_samples: list[float] = []
+    completed = expired = 0
+    shed = cache_hits = 0
+    handed = 0            # rows handed to the device ring
+    ring_backlog = 0      # proven upper bound on the device backlog
+    in_flight = 0
+    drain_rounds = 0
+    dev_shed = 0
+    dev_rounds = 0
+    macro_n = 0
+    ring_depths: list[int] = []
+    rung_counts = None
+    xchg_init = xchg_round = 0
+    blocked_s = 0.0
+    sleep_s = 0.0
+    dispatch_s = 0.0
+    overload = overload_queue_factor * c
+    pend = None           # (out handle, rnd0, rnd_end) of macro k
+
+    def _drain(o, r0):
+        nonlocal completed, expired, cache_hits, in_flight, \
+            ring_backlog, dev_shed, dev_rounds, macro_n, \
+            rung_counts, xchg_init, xchg_round
+        macro_n += 1
+        dev_rounds += int(o.rounds_run)
+        dev_shed = int(o.shed)
+        ring_depths.append(int(o.queued))
+        rc = np.asarray(o.rung_counts, np.int64)
+        rung_counts = rc if rung_counts is None else rung_counts + rc
+        xchg_init += int(o.xchg_init_rows)
+        xchg_round += int(o.xchg_round_rows)
+        mr = marks_r[-tw:]
+        mw = marks_w[-tw:]
+        # Cache hits: answered at pop time (the start of the macro),
+        # zero rounds, zero hops — latency is pure queueing delay.
+        # All record keeping is VECTORIZED (array chunks, concatenated
+        # once at report time): per-row Python here would put the host
+        # back on the serve wall the resident program just left.
+        hit = np.asarray(o.hit)
+        n_hit = int(hit.sum())
+        if n_hit:
+            w0 = float(np.interp(r0, mr, mw))
+            hreq = np.asarray(o.hit_req)[hit].astype(np.int64)
+            cw = np.maximum(w0, enq_wall[hreq])
+            rec_req.append(hreq)
+            rec_lat.append(np.maximum(0.0, cw - arrival_np[hreq]))
+            rec_hops.append(np.zeros(n_hit, np.int64))
+            rec_rounds.append(np.zeros(n_hit, np.int64))
+            rec_found.append(np.asarray(o.hit_found)[hit][:, 0] >= 0)
+        cache_hits += n_hit
+        completed += n_hit
+        comp = np.asarray(o.comp)
+        if comp.any():
+            sl = np.nonzero(comp)[0]
+            req = np.asarray(o.comp_req)[sl].astype(np.int64)
+            cr = np.asarray(o.comp_com)[sl]
+            # Done with no completion stamp = in-jit expiry — booked
+            # expired, never a latency sample.
+            live = cr >= 0
+            expired += int((~live).sum())
+            if live.any():
+                req, cr = req[live], cr[live]
+                adm = np.asarray(o.comp_adm)[sl][live]
+                w = np.maximum(np.interp(cr + 1, mr, mw),
+                               enq_wall[req])
+                rec_req.append(req)
+                rec_lat.append(np.maximum(0.0, w - arrival_np[req]))
+                rec_hops.append(np.asarray(o.comp_hops)[sl][live]
+                                .astype(np.int64))
+                rec_rounds.append((cr - adm + 1).astype(np.int64))
+                rec_found.append(
+                    np.asarray(o.comp_found)[sl][live][:, 0] >= 0)
+                completed += int(live.sum())
+        in_flight = int(o.head) - completed - expired
+        ring_backlog = int(o.queued)
+        occ_samples.append(in_flight / c)
+
+    t0 = clock()
+    while True:
+        now = clock() - t0
+        new_ev = int(np.searchsorted(arrival_np, now, side="right"))
+        if new_ev > next_ev:
+            queue.extend(range(next_ev, new_ev))
+            next_ev = new_ev
+        if len(queue) > overload:
+            if admission is not None \
+                    and admission.policy in ("shed", "degrade"):
+                over = len(queue) - overload
+                del queue[-over:]
+                shed += over
+            else:
+                raise ServeOverloadError(
+                    f"serve overload: admission queue reached "
+                    f"{len(queue)} requests (> "
+                    f"{overload_queue_factor} x {c} slots) at "
+                    f"t={now:.2f}s — the arrival rate exceeds what "
+                    f"this slot capacity sustains on this machine; "
+                    f"lower --arrival-rate, raise --serve-slots, or "
+                    f"shed with --admission shed")
+        if now > hard_wall:
+            if admission is not None \
+                    and admission.policy in ("shed", "degrade"):
+                shed += len(queue) + (r_total - next_ev)
+                queue.clear()
+                next_ev = r_total
+            else:
+                raise ServeOverloadError(
+                    f"serve overload: run exceeded the "
+                    f"{hard_wall:.0f}s hard wall "
+                    f"({r_total - next_ev + len(queue)} requests "
+                    f"not yet admitted, {in_flight} in flight) — "
+                    f"the arrival rate exceeds serve capacity on "
+                    f"this machine")
+        queue_depths.append(len(queue))
+
+        # --- hand-off throttle: the proven backlog bound is the last
+        # drained snapshot plus every batch handed since (at most one,
+        # the double buffer's in-flight macro) — keep one admit_cap of
+        # headroom below the ring so the DEVICE never sheds.
+        safe = engine.ring_slots - ring_backlog - a_cap
+        if pend is not None:
+            safe -= a_cap     # macro k+1's enqueue not yet snapshot
+        m = min(len(queue), a_cap, max(0, safe))
+        if admission is not None and m:
+            take = []
+            qi = 0
+            while qi < len(queue) and len(take) < m:
+                ri = queue[qi]
+                if admission.allow(str(klass[ri]), now):
+                    take.append(ri)
+                elif admission.policy == "shed":
+                    shed += 1
+                else:          # queue: head-of-line waits for tokens
+                    break
+                qi += 1
+            del queue[:qi]
+            m = len(take)
+        else:
+            take = queue[:m]
+            del queue[:m]
+
+        # ``in_flight``/``ring_backlog`` are knowledge as of the LAST
+        # DRAINED macro — rows handed to the still-pending macro are
+        # not in them yet, so "work may exist" is m>0 OR known device
+        # work OR an undrained macro that may have admitted some.
+        busy = m > 0 or in_flight > 0 or ring_backlog > 0
+        new_pend = None
+        if busy:
+            keys_np = np.zeros((a_cap, N_LIMBS), np.uint32)
+            reqs_np = np.full((a_cap,), -1, np.int32)
+            if m:
+                take_np = np.asarray(take, np.int64)
+                keys_np[:m] = keys[take_np]
+                reqs_np[:m] = take_np
+                enq_wall[take_np] = now
+            # The dispatch wall is DEVICE time, not orchestration: on
+            # a synchronous backend (CPU) the macro program runs
+            # inline in this call; on an async one the call returns
+            # fast and the device wait lands in the drain's blocked
+            # window instead — either way the two timers partition the
+            # non-host share of the wall.
+            td = clock()
+            st, rings, out = engine.macro_step(
+                st, rings, jnp.asarray(keys_np),
+                jnp.asarray(reqs_np),
+                jnp.zeros((a_cap,), jnp.int32),
+                jax.random.fold_in(key, it_i), m, rnd)
+            dispatch_s += clock() - td
+            # Mark the macro's round boundary NOW (dispatch return =
+            # compute end on the synchronous backend): completion
+            # walls interpolate against these marks, and stamping
+            # them at drain time instead would tax every latency
+            # sample with the double buffer's one-macro reporting
+            # lag — a wall the device never actually paid.
+            marks_r.append(rnd + rounds)
+            marks_w.append(clock() - t0)
+            handed += m
+            new_pend = (out, rnd, rnd + rounds)
+            rnd += rounds
+            it_i += 1
+
+        if pend is not None:
+            o, r0, _r1 = pend
+            tb = clock()
+            # The steady state's ONE host sync: the PREVIOUS macro's
+            # bulk output, drained while the current macro runs.
+            # graftlint: disable=sync-in-loop (double-buffered drain: reads macro k's output while macro k+1 computes — the resident loop's one amortized readback)
+            o = jax.device_get(o)
+            blocked_s += clock() - tb
+            _drain(o, r0)
+
+        pend = new_pend
+        draining = next_ev >= r_total and not queue
+        if draining and pend is None and in_flight == 0 \
+                and ring_backlog == 0:
+            break
+        if not busy and pend is None:
+            if next_ev < r_total:
+                gap = arrival_ts[next_ev] - (clock() - t0)
+                if gap > 0:
+                    sg = min(gap, 0.05)
+                    sleep(sg)
+                    sleep_s += sg
+                continue
+        if draining and busy and m == 0:
+            drain_rounds += rounds
+            if drain_rounds > drain_cap:
+                break
+
+    if pend is not None:
+        # Drain-cap exit with a macro still in flight (post-loop, so
+        # the steady state pays no extra sync for this cold path).
+        o, r0, _r1 = pend
+        _drain(jax.device_get(o), r0)
+        pend = None
+
+    elapsed = clock() - t0
+    admitted = completed + expired + in_flight
+    shed += dev_shed
+    orch = max(0.0, elapsed - dispatch_s - blocked_s - sleep_s)
+    req_arr = (np.concatenate(rec_req) if rec_req
+               else np.asarray([], np.int64))
+    report = {
+        "slots": c,
+        "admit_cap": a_cap,
+        "burst": rounds,
+        "admitted": admitted,
+        "completed": completed,
+        "expired": expired,
+        "in_flight": in_flight,
+        "never_admitted": len(queue) + (r_total - next_ev)
+        + ring_backlog,
+        "shed": shed,
+        "cache_hits": cache_hits,
+        "cache_misses": (admitted - cache_hits) if use_cache else 0,
+        "degraded_hits": 0,
+        "cache_slots": engine.cache_slots,
+        "admission_policy": admission.policy if admission else None,
+        "sig_submitted": 0,
+        "rounds": rnd,
+        "elapsed_s": elapsed,
+        "sustained_rps": completed / elapsed if elapsed > 0 else 0.0,
+        "request": req_arr,
+        "latency_s": np.concatenate(rec_lat) if rec_lat
+        else np.asarray([], np.float64),
+        "hops": np.concatenate(rec_hops) if rec_hops
+        else np.asarray([], np.int64),
+        "service_rounds": np.concatenate(rec_rounds) if rec_rounds
+        else np.asarray([], np.int64),
+        "found_nonempty": np.concatenate(rec_found) if rec_found
+        else np.asarray([], bool),
+        "klass": np.asarray(klass)[req_arr]
+        if len(req_arr) else np.asarray([], dtype="<U4"),
+        "queue_depth_mean": float(np.mean(queue_depths))
+        if queue_depths else 0.0,
+        "queue_depth_max": int(np.max(queue_depths))
+        if queue_depths else 0,
+        "slot_occupancy_frac": float(np.mean(occ_samples))
+        if occ_samples else 0.0,
+        "burst_marks": list(zip(marks_r, marks_w)),
+        "resident": {
+            "ring_slots": engine.ring_slots,
+            "rounds_per_iter": rounds,
+            "iterations": macro_n,
+            "device_rounds": dev_rounds,
+            "ring_enqueued": handed,
+            "ring_shed": dev_shed,
+            "ring_backlog_final": ring_backlog,
+            "ring_depth_mean": float(np.mean(ring_depths))
+            if ring_depths else 0.0,
+            "ring_depth_max": int(np.max(ring_depths))
+            if ring_depths else 0,
+            "host_orchestration_s": orch,
+            "host_orchestration_frac": orch / elapsed
+            if elapsed > 0 else 0.0,
+            "host_orchestration_budget": host_orchestration_budget,
+            "device_dispatch_s": dispatch_s,
+            "blocked_get_s": blocked_s,
+            "sleep_s": sleep_s,
+            "rung_select": engine.rung_block,
+            "in_jit_rung_counts":
+                [int(x) for x in rung_counts]
+                if rung_counts is not None else [],
+            "exchange": {
+                "rows_init": xchg_init,
+                "rows_round": xchg_round,
+                "row_bytes": getattr(engine, "exchange_row_bytes", 0),
+            },
+        },
+    }
+    return report
 
 
 # ---------------------------------------------------------------------------

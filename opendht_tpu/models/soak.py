@@ -74,6 +74,7 @@ from .monitor import MonitorEngine, kill_node_range
 from .serve import (
     ServeEngine,
     ServeOverloadError,
+    _ring_enqueue,
     _scatter_rows_into,
     poisson_zipf_events,
     warm_serve_engine,
@@ -173,6 +174,28 @@ def _admit_maintenance(swarm: Swarm, cfg: SwarmConfig, st, wc,
     st = _scatter_rows_into(st, new, slots, rnd)
     wc = wc.at[slots].set(jnp.asarray(cls, jnp.int32), mode="drop")
     return st, wc
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _ring_enqueue_maintenance(rings, pool_keys: jax.Array,
+                              pool_idx: jax.Array, n_new: jax.Array,
+                              cls: jax.Array):
+    """Enqueue one maintenance micro-batch into a RESIDENT engine's
+    request ring (the round-20 twin of :func:`_admit_maintenance`):
+    keys gather on device from the sweep's resident pool — exactly the
+    ``_admit_maintenance`` gather, so maintenance keys still never
+    round-trip through the host — and the request index is encoded as
+    ``-2 - pool_idx`` so the harvest side can map a completion ring
+    row back to its sweep position (client requests use indices
+    ``>= 0``; ``-1`` stays the never-written sentinel).  The rings are
+    DONATED; shed/backpressure semantics are the serve ring's
+    (maintenance rows past the free space are counted and dropped —
+    the sweep re-offers them next micro-batch)."""
+    pkeys = pool_keys[jnp.clip(pool_idx, 0, pool_keys.shape[0] - 1)]
+    reqs = jnp.int32(-2) - jnp.asarray(pool_idx, jnp.int32)
+    cls_a = jnp.broadcast_to(jnp.asarray(cls, jnp.int32),
+                             pool_idx.shape)
+    return _ring_enqueue(rings, pkeys, reqs, cls_a, n_new)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
@@ -456,6 +479,22 @@ class SoakEngine:
             jnp.asarray(pool_idx_np), jnp.asarray(slots_np), origins,
             dev_i32(rnd), dev_i32(sweep.cls))
         return st
+
+    def enqueue_maintenance(self, rings, sweep: _Sweep, pool_idx_np,
+                            n: int):
+        """Resident-loop maintenance admission: offer ``n`` sweep rows
+        (``pool_idx_np``, padded to the admission width with ``-1``)
+        to a resident engine's request ring.  The resident program
+        itself pops them into free slots strictly behind earlier-
+        queued serve traffic (ring FIFO order), so the burst loop's
+        "maintenance only into leftover capacity" policy becomes a
+        queue-position property instead of host bookkeeping.  Returns
+        the donated-through rings; decode completions via
+        ``pool_idx = -2 - comp_req`` for rows with ``comp_req <= -2``
+        and class ``sweep.cls``."""
+        return _ring_enqueue_maintenance(
+            rings, sweep.keys_dev, jnp.asarray(pool_idx_np),
+            dev_i32(n), dev_i32(sweep.cls))
 
     def fold_completed(self, sweep: _Sweep, st, sl_np, pos_np):
         sweep.buf = _fold_completed(

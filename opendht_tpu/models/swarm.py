@@ -1388,7 +1388,7 @@ def run_burst_loop(step_fn, state, cfg: SwarmConfig,
         # Per-BURST done poll (explicit device_get: bool() on a device
         # array is an implicit D2H transfer, forbidden under the
         # strict transfer-guard replay).
-        # graftlint: disable=sync-in-loop (per-burst done-check readback, amortized over >=2 device rounds — the burst loop exists to pay this once per burst, not per round)
+        # graftlint: disable=sync-in-loop (per-burst done-check readback, amortized over >=2 device rounds — the burst loop's contract; the round-20 resident serve loop is the zero-per-burst-poll alternative, its early exit living in lax.while_loop cond instead)
         if bool(jax.device_get(jnp.all(done_of(state)))):
             break
         burst = 2
@@ -1595,7 +1595,7 @@ def run_compacted_burst_loop(step_fn, st: LookupState, cfg: SwarmConfig,
             widths.append(w)
         if merge_w not in merge_widths:
             merge_widths.append(merge_w)
-        # graftlint: disable=sync-in-loop (per-burst pending readback steers the ladder width — amortized over >=2 device rounds)
+        # graftlint: disable=sync-in-loop (per-burst pending readback steers the ladder width — amortized over >=2 device rounds; the resident loop's rung_block moves this selection in-jit and pays no readback at all)
         pending, wneed = (int(x) for x in jax.device_get(
             _pending_and_wneed(sub, cfg)))
         if timing:

@@ -95,15 +95,26 @@ ENTRY_POINTS: tuple = (
     ("opendht_tpu.models.serve", "_admit", (2,)),
     ("opendht_tpu.models.serve", "_admit_cached", (2, 3)),
     ("opendht_tpu.models.serve", "_scatter_admission", (0,)),
-    ("opendht_tpu.models.serve", "_scatter_admission_cached", (0, 1)),
+    # Round 20: _scatter_admission_cached retired — the probe runs
+    # standalone before the MASKED routed init so mesh cache hits
+    # never ride the all_to_all; the scatter only drops skip rows.
+    ("opendht_tpu.models.serve", "_scatter_admission_masked", (0,)),
     ("opendht_tpu.models.serve", "_cache_probe", ()),
     ("opendht_tpu.models.serve", "_cache_fill", (0,)),
     ("opendht_tpu.models.serve", "_cache_invalidate", (0,)),
     ("opendht_tpu.models.serve", "_snapshot", ()),
     ("opendht_tpu.models.serve", "_expire_slots", (0,)),
+    # Resident serve loop (round 20): the fused admit→rounds→harvest
+    # macro programs.  Budgets: replay (max_steps, expire off) +
+    # open-loop (rounds_per_iter, expire on) + one rung/cache variant
+    # each before the sweep flags a leak.
+    ("opendht_tpu.models.serve", "_resident_step", (2, 3), 6),
+    ("opendht_tpu.models.serve", "_resident_step_cached",
+     (2, 3, 4), 6),
     ("opendht_tpu.models.soak", "_scatter_wclass", (0,)),
     ("opendht_tpu.models.soak", "_admit_serve_cached", (2, 3, 4)),
     ("opendht_tpu.models.soak", "_admit_maintenance", (2, 3)),
+    ("opendht_tpu.models.soak", "_ring_enqueue_maintenance", (0,)),
     ("opendht_tpu.models.soak", "_fold_completed", (0,)),
     ("opendht_tpu.models.soak", "_repub_insert_completed", (4, 15)),
     ("opendht_tpu.models.soak", "_soak_snapshot", ()),
@@ -131,6 +142,11 @@ ENTRY_POINTS: tuple = (
      (0, 1)),
     ("opendht_tpu.parallel.sharded", "_sharded_rebalance_resize",
      (0, 1)),
+    # Round 20: mesh twin of _resident_step — probe → masked routed
+    # init (hits never ride the all_to_all) → resident rounds →
+    # harvest.  Budget mirrors the local resident programs.
+    ("opendht_tpu.parallel.sharded", "_sharded_resident_step",
+     (2, 3, 4), 6),
     ("opendht_tpu.parallel.sharded_storage", "_sharded_insert", (2,)),
 )
 

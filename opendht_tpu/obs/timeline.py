@@ -417,3 +417,92 @@ class SoakPlane:
         self._occ.set(row["occupancy_maint"], side="maint")
         if row["coverage"] is not None:
             self._cov.set(row["coverage"])
+
+
+# ---------------------------------------------------------------------
+# resident serve loop (round 20)
+# ---------------------------------------------------------------------
+
+def resident_summary(report: dict) -> dict:
+    """Derive the resident loop's headline aggregates from a
+    :func:`~opendht_tpu.models.serve.serve_resident` report — the
+    shared arithmetic between the bench's printed summary, the trace
+    artifact and ``check_trace``'s resident block, so all three read
+    the SAME numbers.
+
+    ``overlap_frac`` is the double-buffer's yield: the share of the
+    run wall spent BLOCKED in the drain ``device_get`` — near 0 means
+    the readback fully overlapped device compute, near 1 means the
+    loop degenerated to the burst engine's sync cadence.
+    ``exchange_mb`` prices the routed exchange from the row counters
+    (0 on the local engine) — the number that drops when mesh cache
+    hits skip the ``all_to_all``.
+    """
+    r = report["resident"]
+    elapsed = report["elapsed_s"]
+    iters = r["iterations"]
+    xchg = r["exchange"]
+    rows = xchg["rows_init"] + xchg["rows_round"]
+    return {
+        "iterations": iters,
+        "device_rounds": r["device_rounds"],
+        "rounds_per_macro": (r["device_rounds"] / iters
+                             if iters else 0.0),
+        "host_orchestration_frac": r["host_orchestration_frac"],
+        "host_orchestration_budget": r["host_orchestration_budget"],
+        "overlap_frac": (r["blocked_get_s"] / elapsed
+                         if elapsed > 0 else 0.0),
+        "ring_utilization": (r["ring_depth_mean"] / r["ring_slots"]
+                             if r["ring_slots"] else 0.0),
+        "ring_shed": r["ring_shed"],
+        "rung_select": r["rung_select"],
+        "in_jit_rung_counts": list(r["in_jit_rung_counts"]),
+        "exchange_rows": rows,
+        "exchange_mb": rows * xchg["row_bytes"] / 1e6,
+    }
+
+
+class ResidentPlane:
+    """Resident serve-loop gauges on the PR-3 registry (``prefix``
+    defaults to ``dht_resident``): counters for macro iterations,
+    device rounds, ring lifecycle events (enqueued / shed) and routed-
+    exchange rows, plus gauges for the host-orchestration share, the
+    drain-blocked (non-overlapped) share and the ring depth — the
+    Prometheus face of :func:`resident_summary`."""
+
+    def __init__(self, registry: MetricsRegistry,
+                 prefix: str = "dht_resident"):
+        self.registry = registry
+        c, g = registry.counter, registry.gauge
+        self._iters = c(f"{prefix}_macro_iterations_total",
+                        "Resident macro steps dispatched")
+        self._rounds = c(f"{prefix}_device_rounds_total",
+                         "Lookup rounds run inside resident programs")
+        self._ring = c(f"{prefix}_ring_events_total",
+                       "Request-ring lifecycle events", ("event",))
+        self._xchg = c(f"{prefix}_exchange_rows_total",
+                       "Routed-exchange rows", ("leg",))
+        self._orch = g(f"{prefix}_host_orchestration_ratio",
+                       "Host share of the serve wall")
+        self._blocked = g(f"{prefix}_drain_blocked_ratio",
+                          "Non-overlapped drain share of the wall")
+        self._depth = g(f"{prefix}_ring_depth",
+                        "Device ring backlog", ("stat",))
+
+    def publish_run(self, report: dict) -> None:
+        r = report["resident"]
+        self._iters.inc(r["iterations"])
+        self._rounds.inc(r["device_rounds"])
+        self._ring.inc(r["ring_enqueued"], event="enqueued")
+        if r["ring_shed"]:
+            self._ring.inc(r["ring_shed"], event="shed")
+        xchg = r["exchange"]
+        if xchg["rows_init"]:
+            self._xchg.inc(xchg["rows_init"], leg="init")
+        if xchg["rows_round"]:
+            self._xchg.inc(xchg["rows_round"], leg="round")
+        s = resident_summary(report)
+        self._orch.set(round(s["host_orchestration_frac"], 6))
+        self._blocked.set(round(s["overlap_frac"], 6))
+        self._depth.set(r["ring_depth_mean"], stat="mean")
+        self._depth.set(r["ring_depth_max"], stat="max")

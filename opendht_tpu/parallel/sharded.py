@@ -250,43 +250,77 @@ def _route_respond(tables_local: jax.Array, ids: jax.Array,
         w3 = 3 * k
         rows = tables_local[safe_row.reshape(-1)]    # [D*C, row_w]
         r_c0p = jnp.clip(r_c0, 0, cfg.n_buckets - 2).reshape(-1)
-        resp = _select_pair_window(
-            rows, r_c0p, w3, cfg.n_buckets).reshape(n_shards, cap,
-                                                    6 * k)
+        resp6 = _select_pair_window(rows, r_c0p, w3, cfg.n_buckets)
+        # SLIM return leg (round 20, ROADMAP #1 follow-up): the s16
+        # window thirds never ship — they are a gather into the
+        # REPLICATED id matrix, so the origin rebuilds them from the
+        # decoded indices with the table builder's exact formula
+        # (:func:`_rebuild_pair_window`), bit-identical by
+        # construction.  [lo K | hi K] per half-row: 4K of the 6K
+        # columns ride, −33 % response-leg bytes.
+        resp = jnp.concatenate([resp6[:, :2 * k],
+                                resp6[:, w3:w3 + 2 * k]],
+                               axis=-1).reshape(n_shards, cap, 4 * k)
         resp = jnp.where((r_row >= 0)[..., None], resp,
                          jnp.uint16(0xFFFF))
-        back = a2a(resp)                                     # [D,C,6K]
-        mine = back.reshape(n_shards * cap, -1)[slot]        # [Q,6K]
+        back = a2a(resp)                                     # [D,C,4K]
+        mine = back.reshape(n_shards * cap, -1)[slot]        # [Q,4K]
         # Window start = the pair start the owner selected — the
         # origin applies the identical clip to its own c0, so no need
         # to ship it back.
         w0 = jnp.clip(c0, 0, cfg.n_buckets - 2)
         t0 = jnp.repeat(targets[:, 0], a)                    # [Q]
+        win = _rebuild_pair_window(mine, w0, ids, n, k)
         r_idx, r_d0 = _unpack_pair_window(
-            mine, w0, w0 + 1, t0, nid_d0.reshape(-1), sent, k)
+            win, w0, w0 + 1, t0, nid_d0.reshape(-1), sent, k)
         return (r_idx.reshape(ll, a * 2 * k),
                 r_d0.reshape(ll, a * 2 * k), sent.reshape(ll, a))
     rows0 = _gather_span(tables_local, safe_row, r_c0 * k, k)
     rows1 = _gather_span(tables_local, safe_row, r_c1 * k, k)
-    m0 = jax.lax.bitcast_convert_type(ids[:, 0][jnp.clip(
-        jnp.concatenate([rows0, rows1], axis=-1), 0, n - 1)],
-        jnp.int32)
-    rows0 = jnp.concatenate([rows0, m0[..., :k]], axis=-1)
-    rows1 = jnp.concatenate([rows1, m0[..., k:]], axis=-1)
-    resp = jnp.concatenate([rows0, rows1], axis=-1)          # [D,C,4K]
+    # SLIM return leg (round 20): only the member INDICES ship back —
+    # the member limb used to ride as an owner-side id gather, but
+    # the id matrix is replicated, so the origin gathers it locally
+    # from the same indices (identical values, half the bytes).
+    resp = jnp.concatenate([rows0, rows1], axis=-1)          # [D,C,2K]
     resp = jnp.where((r_row >= 0)[..., None], resp, -1)
 
-    back = a2a(resp)                                         # [D,C,4K]
-    mine = back.reshape(n_shards * cap, -1)[slot]            # [Q,4K]
+    back = a2a(resp)                                         # [D,C,2K]
+    mine = back.reshape(n_shards * cap, -1)[slot]            # [Q,2K]
     mine = jnp.where(sent[:, None], mine, -1)
-    r_idx = jnp.concatenate([mine[:, :k], mine[:, 2 * k:3 * k]],
-                            axis=-1).reshape(ll, a * 2 * k)
-    r_m0 = jax.lax.bitcast_convert_type(
-        jnp.concatenate([mine[:, k:2 * k], mine[:, 3 * k:]], axis=-1),
-        jnp.uint32).reshape(ll, a * 2 * k)
+    r_idx = mine.reshape(ll, a * 2 * k)
+    r_m0 = ids[:, 0][jnp.clip(mine, 0, n - 1)] \
+        .reshape(ll, a * 2 * k)
     r_d0 = r_m0 ^ targets[:, 0][:, None]
     r_d0 = jnp.where(r_idx < 0, jnp.uint32(0xFFFFFFFF), r_d0)
     return r_idx, r_d0, sent.reshape(ll, a)
+
+
+def _rebuild_pair_window(mine: jax.Array, w0: jax.Array,
+                         ids: jax.Array, n: int, k: int) -> jax.Array:
+    """Rebuild the ``[Q,6K]`` augmented pair window from its slimmed
+    ``[Q,4K]`` wire form (``[lo0 K | hi0 K | lo1 K | hi1 K]``).
+
+    Each half-row's s16 third is recomputed with the table builder's
+    exact formula ``((m0 << b) >> 16)`` (models/swarm._build_bucket)
+    at window start ``w0 + r`` — for occupied slots ``m0`` is the
+    SAME replicated-id gather the builder did, so the rebuilt window
+    is bit-identical to the stored one; for empty slots the builder
+    itself stored the index-0 clip garbage this reproduces, and
+    capacity-dropped rows decode to index −1 whose s16 is masked by
+    ``_unpack_pair_window``'s validity anyway."""
+    halves = []
+    for r in range(2):
+        lo = mine[:, r * 2 * k:r * 2 * k + k].astype(jnp.uint32)
+        hi = mine[:, r * 2 * k + k:r * 2 * k + 2 * k] \
+            .astype(jnp.uint32)
+        idx = jax.lax.bitcast_convert_type(
+            lo | (hi << jnp.uint32(16)), jnp.int32)
+        m0 = ids[:, 0][jnp.clip(idx, 0, n - 1)]
+        wu = (w0 + r).astype(jnp.uint32)[:, None]
+        s16 = ((m0 << wu) >> jnp.uint32(16)).astype(jnp.uint16)
+        halves.append(jnp.concatenate(
+            [mine[:, r * 2 * k:r * 2 * k + 2 * k], s16], axis=-1))
+    return jnp.concatenate(halves, axis=-1)
 
 
 def _make_responders(cfg: SwarmConfig, n_shards: int,
@@ -388,12 +422,20 @@ def _make_respond_body(cfg, n_shards, capacity_factor, local_respond,
     ``completed_round`` stamp), so untracked programs stay
     byte-identical.  ``merge_w`` is the static merge-width rung
     (guarded in-jit — see ``rank_merge_round_d0_w``); ``None`` keeps
-    the exact pre-ladder program."""
-    def init_body(ids, tables_local, alive, targets, key):
+    the exact pre-ladder program.  The init body takes an optional
+    per-row ``skip`` mask (``_sharded_lookup_init_masked``): skipped
+    rows' origins are blanked to −1 so they never enter the routed
+    seed exchange — how cache hits stay OFF the ``all_to_all``.  The
+    origin draw stays FULL-width and runs BEFORE the blanking, so
+    non-skipped rows' origins are bit-identical to the unmasked
+    body's."""
+    def init_body(ids, tables_local, alive, targets, key, skip=None):
         ll = targets.shape[0]
         me = jax.lax.axis_index(AXIS)
         key = jax.random.fold_in(key, me)
         origins = _sample_origins(key, alive, ll)
+        if skip is not None:
+            origins = jnp.where(skip, -1, origins)
         respond_init, _ = _make_responders(
             cfg, n_shards, capacity_factor, local_respond, ids,
             tables_local, alive)
@@ -465,6 +507,137 @@ def _sharded_lookup_step(swarm, cfg, st, mesh, capacity_factor,
     fn = shard_map(body, mesh=mesh, in_specs=in_specs,
                    out_specs=_st_specs(track), check_vma=False)
     return fn(*args)
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor",
+                                   "local_respond"))
+def _sharded_lookup_init_masked(swarm, cfg, targets, key, skip, mesh,
+                                capacity_factor,
+                                local_respond=False):
+    """Routed init with a per-row ``skip`` mask (cache-aware sharded
+    admission, round 20): skipped rows never solicit, so they never
+    ride the ``all_to_all`` — non-skipped rows are bit-identical to
+    :func:`_sharded_lookup_init` (asserted in tests)."""
+    n_shards = mesh.shape[AXIS]
+    fn = shard_map(
+        _make_respond_body(cfg, n_shards, capacity_factor,
+                           local_respond, init=True),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS, None), P(), P(AXIS, None), P(),
+                  P(AXIS)),
+        out_specs=_st_specs(), check_vma=False)
+    return fn(swarm.ids, swarm.tables, swarm.alive, targets, key,
+              skip)
+
+
+def _resident_rounds_body(cfg, n_shards, capacity_factor, rounds):
+    """Per-shard body of the sharded resident round loop: the burst
+    path's routed round (same ``_make_responders`` contract,
+    ``cap_nq=None`` so capacities match the per-round burst engine —
+    the replay identity) inside ONE psum-synchronised
+    ``lax.while_loop`` with on-device early exit.  Carries a
+    provisioned-solicitation-row counter (pending rows × α, the
+    routed exchange's per-round row budget) for the trace's
+    exchange accounting."""
+    def body_fn(ids, tables_local, alive, st, rnd0):
+        _, respond = _make_responders(
+            cfg, n_shards, capacity_factor, False, ids, tables_local,
+            alive)
+
+        def cond(carry):
+            st, it, _xr = carry
+            pending = jax.lax.psum(jnp.sum(~st.done), AXIS)
+            return (pending > 0) & (it < jnp.int32(rounds))
+
+        def body(carry):
+            st, it, xr = carry
+            n_pend = jax.lax.psum(
+                jnp.sum((~st.done).astype(jnp.int32)), AXIS)
+            st = step_impl(ids, alive, respond, cfg, st,
+                           rnd=rnd0 + it)
+            return st, it + 1, xr + n_pend * jnp.int32(cfg.alpha)
+
+        st, it, xr = jax.lax.while_loop(
+            cond, body, (st, jnp.int32(0), jnp.int32(0)))
+        return st, it, xr
+    return body_fn
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh", "capacity_factor",
+                                   "rounds", "expire"),
+         donate_argnums=(2, 3, 4))
+def _sharded_resident_step(swarm, cfg, st, rings, cache, keys, reqs,
+                           cls, key, n_new, rnd0, mesh,
+                           capacity_factor, *, rounds, expire=True):
+    """The mesh resident macro step (ISSUE 20): enqueue → pop →
+    replicated-cache probe → MASKED routed init → scatter → one
+    psum-synchronised routed round loop → shared harvest tail, all
+    one program.
+
+    The probe runs BEFORE the routed init and hit rows are handed to
+    the init as ``skip`` — a mesh cache hit never rides the
+    ``all_to_all`` (``xchg_init_rows`` counts only admitted rows, the
+    provable counter).  Rings and cache are replicated like the
+    burst engine's cache; the state is sharded exactly like the burst
+    serve state, and every round is the burst path's routed round at
+    the same round index, so the resident sharded replay is
+    bit-identical to ``sharded_lookup(compact=False)``."""
+    from ..models import serve as sv
+    n_shards = mesh.shape[AXIS]
+    c = st.done.shape[0]
+    a = keys.shape[0]
+    rings = sv._ring_enqueue(rings, keys, reqs, cls, n_new)
+    rings, pkeys, preq, pcls, cand, valid = sv._ring_pop(st, rings, a)
+    if cache is not None:
+        hit_raw, h_found, h_hops = sv._probe_impl(cache, pkeys)
+        hit = hit_raw & valid
+    else:
+        hit = jnp.zeros((a,), bool)
+        h_found = jnp.full((a, cfg.quorum), -1, jnp.int32)
+        h_hops = jnp.zeros((a,), jnp.int32)
+    take = valid & ~hit
+    new = _sharded_lookup_init_masked(swarm, cfg, pkeys, key, ~take,
+                                      mesh, capacity_factor)
+    eff = jnp.where(take, cand, jnp.int32(c))
+    st = sv._scatter_rows_into(st, new, eff, rnd0)
+    rings = rings._replace(
+        slot_req=rings.slot_req.at[eff].set(preq, mode="drop"),
+        slot_cls=rings.slot_cls.at[eff].set(pcls, mode="drop"))
+    fn = shard_map(
+        _resident_rounds_body(cfg, n_shards, capacity_factor, rounds),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS, None), P(), _st_specs(True), P()),
+        out_specs=(_st_specs(True), P(), P()), check_vma=False)
+    st, rounds_run, xchg_round = fn(swarm.ids, swarm.tables,
+                                    swarm.alive, st, rnd0)
+    rnd_end = rnd0 + jnp.int32(rounds)
+    st, rings, cache, comp, fin = sv._resident_tail(
+        swarm.ids, cfg, st, rings, cache, rnd_end, expire)
+    out = sv.ResidentOut(
+        adm=jnp.sum(take.astype(jnp.int32)),
+        hits=jnp.sum(hit.astype(jnp.int32)),
+        queued=rings.tail - rings.head,
+        head=rings.head, tail=rings.tail, shed=rings.shed,
+        rounds_run=rounds_run,
+        hit=hit,
+        hit_req=jnp.where(hit, preq, -1),
+        hit_found=h_found, hit_hops=h_hops,
+        comp=comp,
+        comp_req=jnp.where(comp, rings.slot_req, -1),
+        comp_cls=jnp.where(comp, rings.slot_cls, -1),
+        comp_hops=st.hops,
+        comp_adm=st.admitted_round,
+        comp_com=st.completed_round,
+        comp_found=fin,
+        rung_counts=jnp.zeros((1,), jnp.int32),
+        xchg_init_rows=jnp.sum(take.astype(jnp.int32)),
+        xchg_round_rows=xchg_round)
+    st = st._replace(
+        admitted_round=jnp.where(comp, -1, st.admitted_round))
+    rings = rings._replace(
+        slot_req=jnp.where(comp, -1, rings.slot_req),
+        slot_cls=jnp.where(comp, -1, rings.slot_cls))
+    return st, rings, cache, out
 
 
 def _table_bytes_per_device(cfg: SwarmConfig, n_shards: int) -> int:
@@ -773,7 +946,7 @@ def sharded_lookup(swarm: Swarm, cfg: SwarmConfig, targets: jax.Array,
             widths.append(w)
         if merge_w not in merge_widths:
             merge_widths.append(merge_w)
-        # graftlint: disable=sync-in-loop (per-BURST done-check readback, amortized over >=2 device rounds — the ladder exists to pay this once per burst, not per round)
+        # graftlint: disable=sync-in-loop (per-BURST done-check readback, amortized over >=2 device rounds — the ladder's contract; _sharded_resident_step is the zero-poll alternative, its psum'd early exit living in the shard_map while_loop cond)
         pend, wneed = jax.device_get(
             _shard_pending_and_wneed(sub, cfg, n_shards))
         total = int(pend.sum())

@@ -496,7 +496,92 @@ def check_serve_obj(obj: dict) -> List[str]:
     occ = bench.get("slot_occupancy_frac")
     if occ is not None and not (_num(occ) and 0.0 <= occ <= 1.0):
         errs.append(f"slot_occupancy_frac not a fraction: {occ!r}")
+
+    # Resident-loop block (round 20): ring conservation, depth bounds,
+    # the host-orchestration share against the artifact's own recorded
+    # budget, and the in-jit rung counts against the device rounds.
+    res = obj.get("resident")
+    if res is None and bench.get("serve_engine") == "resident":
+        errs.append("bench row claims serve_engine 'resident' but the "
+                    "artifact has no resident block")
+    if res is not None:
+        _check_resident_block(res, bench, admitted, never, errs)
     return errs
+
+
+def _check_resident_block(res: dict, bench: dict, admitted, never,
+                          errs: List[str]) -> None:
+    """The resident serve loop's contract (round 20), held against the
+    artifact: every ring-enqueued row is accounted (admitted, still in
+    the device ring, or shed BY the ring), ring depths stay inside the
+    ring, the host-orchestration share is a fraction at or under the
+    RECORDED budget (the <5 % acceptance gate rides in the artifact,
+    so a regressed run fails its own file), and with rung selection on
+    the in-jit counts must sum to the device rounds — each round picks
+    exactly one rung."""
+    iters = res.get("iterations")
+    ring_slots = res.get("ring_slots")
+    enq = res.get("ring_enqueued")
+    r_shed = res.get("ring_shed", 0)
+    backlog = res.get("ring_backlog_final", 0)
+    d_mean = res.get("ring_depth_mean")
+    d_max = res.get("ring_depth_max")
+    orch = res.get("host_orchestration_frac")
+    budget = res.get("host_orchestration_budget")
+    dev_rounds = res.get("device_rounds")
+    for name, v in (("iterations", iters), ("ring_slots", ring_slots),
+                    ("ring_enqueued", enq), ("ring_shed", r_shed),
+                    ("ring_backlog_final", backlog),
+                    ("ring_depth_mean", d_mean),
+                    ("ring_depth_max", d_max),
+                    ("device_rounds", dev_rounds)):
+        if not (_num(v) and v >= 0):
+            errs.append(f"resident {name} invalid: {v!r}")
+            return
+    if iters < 1:
+        errs.append("resident block with zero macro iterations — "
+                    "nothing resident ran")
+    # The ring's own conservation: rows handed to the device ring are
+    # admitted into slots, still queued, or shed by the ring —
+    # admitted here includes cache hits (a hit is admitted-and-
+    # completed at pop time without occupying a slot).
+    if _num(admitted) and enq != admitted + backlog + r_shed:
+        errs.append(f"resident ring does not conserve: ring_enqueued "
+                    f"{enq} != admitted {admitted} + "
+                    f"ring_backlog_final {backlog} + ring_shed "
+                    f"{r_shed}")
+    if _num(never) and backlog > never:
+        errs.append(f"resident ring_backlog_final {backlog} > "
+                    f"never_admitted {never} — queued ring rows must "
+                    f"be booked never-admitted")
+    if d_max > ring_slots:
+        errs.append(f"resident ring_depth_max {d_max} > ring_slots "
+                    f"{ring_slots}")
+    if d_mean > d_max + 1e-9:
+        errs.append(f"resident ring_depth_mean {d_mean} > "
+                    f"ring_depth_max {d_max}")
+    if not (_num(orch) and 0.0 <= orch <= 1.0):
+        errs.append(f"resident host_orchestration_frac not a "
+                    f"fraction: {orch!r}")
+    elif _num(budget) and orch > budget + 1e-9:
+        errs.append(f"resident host_orchestration_frac {orch:.4f} "
+                    f"exceeds the recorded budget {budget} — the "
+                    f"serve wall is no longer device-dominated")
+    rung = res.get("rung_select")
+    counts = res.get("in_jit_rung_counts") or []
+    if rung:
+        if any((not _num(c)) or c < 0 for c in counts):
+            errs.append(f"resident in_jit_rung_counts invalid: "
+                        f"{counts!r}")
+        elif sum(counts) != dev_rounds:
+            errs.append(f"resident in_jit_rung_counts sum "
+                        f"{sum(counts)} != device_rounds {dev_rounds} "
+                        f"— each round selects exactly one rung")
+    xchg = res.get("exchange") or {}
+    for name in ("rows_init", "rows_round", "row_bytes"):
+        v = xchg.get(name, 0)
+        if not (_num(v) and v >= 0):
+            errs.append(f"resident exchange {name} invalid: {v!r}")
 
 
 # Hard ceiling on the hop-fidelity band a monitor artifact may state:

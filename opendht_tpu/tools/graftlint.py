@@ -1811,8 +1811,8 @@ def _build_workloads():
                               dev_i32(0))
         # Hot-key result-cache overlay (ISSUE 12): probe-fused admit
         # (state + cache donated), harvest fill, standalone degrade
-        # probe, epoch-bump invalidate, and the sharded cached scatter
-        # — every donated operand freshly built, never reused.
+        # probe, epoch-bump invalidate, and the round-20 masked
+        # scatter — every donated operand freshly built, never reused.
         eng_c = sv.ServeEngine(swarm, cfg, slots=256, admit_cap=128,
                                cache_slots=256)
         stc = eng_c.empty()
@@ -1825,13 +1825,48 @@ def _build_workloads():
         eng_c.probe_cache(targets[:128])
         eng_c.invalidate_cache()
         st5 = sv.empty_serve_state(cfg, 256)
-        cache5 = sv.empty_result_cache(cfg, 256)
         new5 = sw.lookup_init(swarm, cfg, targets[:128],
                               sw._sample_origins(jax.random.PRNGKey(23),
                                                  swarm.alive, 128))
-        sv._scatter_admission_cached(st5, cache5, new5,
+        sv._scatter_admission_masked(st5, new5,
                                      jnp.arange(128, dtype=jnp.int32),
+                                     jnp.zeros((128,), bool),
                                      dev_i32(0))
+
+    def resident_engine():
+        # Round-20 resident serve loop: replay (the full-round-budget
+        # macro), the open-loop shape (short rounds, expire on), the
+        # cached macro, and an in-jit rung-select variant — the four
+        # lifecycle corners the _resident_step* budgets price.  Every
+        # macro_step call donates (state, rings[, cache]) and the
+        # engine hands back fresh replacements, so no donated operand
+        # is ever reused.
+        sv.resident_closed_loop_replay(swarm, cfg, targets[:256], key)
+        eng_r = sv.ResidentServeEngine(swarm, cfg, slots=256,
+                                       admit_cap=128, ring_slots=512)
+        st = eng_r.empty()
+        rings = eng_r.empty_rings()
+        st, rings, _out = eng_r.macro_step(
+            st, rings, targets[:128],
+            jnp.arange(128, dtype=jnp.int32),
+            jnp.zeros((128,), jnp.int32), key, 128, 0)
+        eng_c = sv.ResidentServeEngine(swarm, cfg, slots=256,
+                                       admit_cap=128, ring_slots=512,
+                                       cache_slots=256)
+        stc = eng_c.empty()
+        ringsc = eng_c.empty_rings()
+        stc, ringsc, _outc = eng_c.macro_step(
+            stc, ringsc, targets[:128],
+            jnp.arange(128, dtype=jnp.int32),
+            jnp.zeros((128,), jnp.int32), key, 128, 0)
+        eng_w = sv.ResidentServeEngine(swarm, cfg, slots=256,
+                                       admit_cap=128, ring_slots=512,
+                                       rung_block=8)
+        stw = eng_w.empty()
+        ringsw = eng_w.empty_rings()
+        eng_w.macro_step(stw, ringsw, targets[:128],
+                         jnp.arange(128, dtype=jnp.int32),
+                         jnp.zeros((128,), jnp.int32), key, 128, 0)
 
     def storage_paths():
         scfg = stg.StoreConfig(slots=4, listen_slots=2,
@@ -1949,6 +1984,21 @@ def _build_workloads():
             st2, order_r, cfg8, mesh, 128)
         sh._sharded_rebalance_resize(fullr, orderr, subr, cfg8, mesh,
                                      64)
+        # Round-20 mesh resident macro: probe → masked routed init →
+        # psum round loop → harvest, one donated (state, rings, cache)
+        # trio per call; plus the masked init driven standalone (the
+        # cache-aware burst admission path).
+        eng_sr = sv.ShardedResidentServeEngine(
+            sw8, cfg8, 256, mesh, admit_cap=256, ring_slots=512,
+            cache_slots=256)
+        str8 = eng_sr.empty()
+        rg8 = eng_sr.empty_rings()
+        eng_sr.macro_step(str8, rg8, tg[:256],
+                          jnp.arange(256, dtype=jnp.int32),
+                          jnp.zeros((256,), jnp.int32), key, 256, 0)
+        sh._sharded_lookup_init_masked(
+            sw8, cfg8, tg[:256], key, jnp.zeros((256,), bool), mesh,
+            2.0)
         # routed storage insert (_sharded_insert — donated store)
         from ..parallel import sharded_storage as shst
         scfg8 = stg.StoreConfig(slots=4, listen_slots=2,
@@ -1998,6 +2048,11 @@ def _build_workloads():
             sw._sample_origins(jax.random.PRNGKey(22), swarm.alive,
                                a),
             dev_i32(0), dev_i32(sk.WC_REPUB))
+        # Round-20 resident-ring maintenance enqueue (rings donated).
+        rings_m = sv.empty_serve_rings(c, 4 * a)
+        sk._ring_enqueue_maintenance(
+            rings_m, pool, jnp.arange(a, dtype=jnp.int32) % 64,
+            dev_i32(a), dev_i32(sk.WC_REPUB))
         buf = jnp.full((64, cfg.quorum), -1, jnp.int32)
         sk._fold_completed(buf, swarm.ids, st, cfg,
                            jnp.zeros((a,), jnp.int32),
@@ -2022,6 +2077,7 @@ def _build_workloads():
         "local-engines": local_engines,
         "compaction-plumbing": compaction_plumbing,
         "serve-engine": serve_engine,
+        "resident-engine": resident_engine,
         "soak-engine": soak_engine,
         "storage-paths": storage_paths,
         "integrity-plane": integrity_plane,

@@ -1112,3 +1112,486 @@ class TestPerKeyBuckets:
         assert rep["shed"] > 0.5 * n_hot
         assert rep["admitted"] == rep["completed"] + rep["expired"] \
             + rep["in_flight"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: device-resident serve loop — rings, replay identity, checker
+# ---------------------------------------------------------------------------
+
+from opendht_tpu.models.serve import (  # noqa: E402
+    ResidentServeEngine,
+    ShardedResidentServeEngine,
+    _ring_enqueue,
+    _ring_pop,
+    empty_serve_rings,
+    resident_closed_loop_replay,
+    serve_resident,
+)
+
+
+class TestServeRings:
+    """The device admission ring in isolation: conservation across
+    enqueue/pop, explicit full-ring backpressure (shed, never a silent
+    overwrite), wraparound FIFO order, and the pop side's free-slot
+    pairing contract."""
+
+    def _keys(self, seed, n):
+        return jax.random.bits(jax.random.PRNGKey(seed), (n, 5),
+                               jnp.uint32)
+
+    def _batch(self, seed, n, req0=0):
+        return (self._keys(seed, n),
+                jnp.arange(req0, req0 + n, dtype=jnp.int32),
+                jnp.zeros((n,), jnp.int32))
+
+    def test_full_ring_backpressure_sheds(self):
+        rings = empty_serve_rings(8, 8)
+        k, r, c = self._batch(0, 6)
+        rings = _ring_enqueue(rings, k, r, c, jnp.int32(6))
+        assert int(rings.tail) == 6 and int(rings.shed) == 0
+        # Only 2 rows of space left: 4 of the next 6 are SHED.
+        k2, r2, c2 = self._batch(1, 6, req0=6)
+        rings = _ring_enqueue(rings, k2, r2, c2, jnp.int32(6))
+        assert int(rings.tail) == 8
+        assert int(rings.shed) == 4
+        # Conservation: offered == queued + shed (nothing popped yet).
+        offered = 12
+        assert int(rings.tail - rings.head) + int(rings.shed) \
+            == offered
+        # The two accepted rows of batch 2 are reqs 6 and 7 — the shed
+        # rows are the TAIL of the batch, never a mid-batch hole.
+        pos = np.asarray((rings.tail - 2 + jnp.arange(2)) % 8)
+        assert np.asarray(rings.rq_req)[pos].tolist() == [6, 7]
+
+    def test_wraparound_fifo_order(self):
+        """Five enqueue/pop cycles of 4 through an 8-deep ring cross
+        the wrap point twice; every popped row must come out in global
+        FIFO order with its enqueued key intact."""
+        st = ServeEngine(build_swarm(jax.random.PRNGKey(5),
+                                     SwarmConfig.for_nodes(64)),
+                         SwarmConfig.for_nodes(64), slots=8).empty()
+        rings = empty_serve_rings(8, 8)
+        all_keys = self._keys(2, 20)
+        seen_req, seen_keys = [], []
+        for cyc in range(5):
+            k = all_keys[4 * cyc:4 * cyc + 4]
+            r = jnp.arange(4 * cyc, 4 * cyc + 4, dtype=jnp.int32)
+            rings = _ring_enqueue(rings, k, r,
+                                  jnp.zeros((4,), jnp.int32),
+                                  jnp.int32(4))
+            rings, pkeys, preq, pcls, cand, valid = \
+                _ring_pop(st, rings, 4)
+            v = np.asarray(valid)
+            assert v.all()          # backlog 4, 8 free slots, a=4
+            seen_req += np.asarray(preq).tolist()
+            seen_keys += [np.asarray(pkeys)[i] for i in range(4)]
+        assert seen_req == list(range(20))
+        assert np.array_equal(np.stack(seen_keys),
+                              np.asarray(all_keys))
+        assert int(rings.head) == 20 and int(rings.tail) == 20
+        assert int(rings.shed) == 0
+
+    def test_pop_respects_free_slots_lowest_first(self):
+        """Pop capacity is min(backlog, free, a) and free slots are
+        taken lowest-index-first (the stable argsort that anchors the
+        replay identity)."""
+        cfg = SwarmConfig.for_nodes(64)
+        st = ServeEngine(build_swarm(jax.random.PRNGKey(5), cfg),
+                         cfg, slots=8).empty()
+        # Mark slots 0, 2, 3, 6 busy: free = {1, 4, 5, 7}.
+        busy = jnp.zeros((8,), bool).at[jnp.array([0, 2, 3, 6])] \
+            .set(True)
+        st = st._replace(done=~busy)
+        rings = empty_serve_rings(8, 16)
+        k, r, c = self._batch(3, 6)
+        rings = _ring_enqueue(rings, k, r, c, jnp.int32(6))
+        rings, pkeys, preq, pcls, cand, valid = _ring_pop(st, rings, 6)
+        v = np.asarray(valid)
+        assert v.sum() == 4         # 4 free slots < backlog 6 < a 6
+        assert np.asarray(cand)[v].tolist() == [1, 4, 5, 7]
+        assert np.asarray(preq)[v].tolist() == [0, 1, 2, 3]
+        assert (np.asarray(preq)[~v] == -1).all()
+        # The two unpopped rows stay queued — head advanced by 4 only.
+        assert int(rings.tail - rings.head) == 2
+
+
+class TestResidentReplay:
+    """Tentpole acceptance: the ONE-program resident replay is
+    bit-identical (found/hops/done) to the batch lookup and to the
+    burst engine's closed-loop replay — healthy and churned, rung
+    selection on and off, cache on (cold) and off."""
+
+    def test_bit_identical_to_lookup_and_burst_replay(self, churned,
+                                                      targets):
+        r_batch = lookup(churned, CFG, targets, jax.random.PRNGKey(2),
+                         compact=False)
+        r_burst, _ = closed_loop_replay(churned, CFG, targets,
+                                        jax.random.PRNGKey(2))
+        r_res, st, out = resident_closed_loop_replay(
+            churned, CFG, targets, jax.random.PRNGKey(2))
+        assert _res_equal(r_res, r_batch)
+        assert _res_equal(r_res, r_burst)
+        # Slot j served request j (the stable-argsort pairing).
+        assert np.asarray(out.comp_req).tolist() == list(range(L))
+        assert int(out.adm) == L and int(out.shed) == 0
+        assert int(out.queued) == 0
+
+    def test_bit_identical_healthy(self, swarm, targets):
+        r_batch = lookup(swarm, CFG, targets, jax.random.PRNGKey(2),
+                         compact=False)
+        r_res, _, _ = resident_closed_loop_replay(
+            swarm, CFG, targets, jax.random.PRNGKey(2))
+        assert _res_equal(r_res, r_batch)
+
+    def test_rung_select_replay_identical(self, churned, targets):
+        """In-jit width-ladder rung selection changes WHICH merge width
+        each round pays, never the merged shortlist — and each device
+        round selects exactly one rung."""
+        eng = ResidentServeEngine(churned, CFG, slots=L, admit_cap=L,
+                                  ring_slots=2 * L, rung_block=8)
+        r_base, _, _ = resident_closed_loop_replay(
+            churned, CFG, targets, jax.random.PRNGKey(2))
+        r_rung, _, out = resident_closed_loop_replay(
+            churned, CFG, targets, jax.random.PRNGKey(2), engine=eng)
+        assert _res_equal(r_rung, r_base)
+        counts = np.asarray(out.rung_counts)
+        assert (counts >= 0).all()
+        assert counts.sum() == int(out.rounds_run)
+
+    def test_cache_cold_macro_identical_warm_macro_hits(self, churned):
+        """Cache riding the resident program: a cold macro step is
+        bit-identical to the cache-off macro, and a warm repeat answers
+        from the completion ring's fills at pop time — hit payloads
+        exactly the first run's completions, hit rows never occupying
+        a slot."""
+        n = 64
+        tg = jax.random.bits(jax.random.PRNGKey(21), (n, 5),
+                             jnp.uint32)
+        reqs = jnp.arange(n, dtype=jnp.int32)
+        cls = jnp.zeros((n,), jnp.int32)
+        key = jax.random.PRNGKey(4)
+
+        def run(cache_slots, use_cache, macros=1):
+            eng = ResidentServeEngine(churned, CFG, slots=n,
+                                      admit_cap=n, ring_slots=2 * n,
+                                      cache_slots=cache_slots)
+            st, rings = eng.empty(), eng.empty_rings()
+            outs = []
+            for m in range(macros):
+                st, rings, out = eng.macro_step(
+                    st, rings, tg, reqs, cls, key, n, 0,
+                    rounds=CFG.max_steps, expire=False,
+                    use_cache=use_cache)
+                outs.append(out)
+            return outs
+
+        (out_off,) = run(0, False)
+        out_cold, out_warm = run(256, True, macros=2)
+        assert int(out_cold.hits) == 0
+        for f in ("comp", "comp_req", "comp_found", "comp_hops"):
+            assert np.array_equal(np.asarray(getattr(out_cold, f)),
+                                  np.asarray(getattr(out_off, f))), f
+        hits = np.asarray(out_warm.hit)
+        assert hits.sum() > 0
+        assert int(out_warm.hits) + int(out_warm.adm) == n
+        hr = np.asarray(out_warm.hit_req)[hits]
+        # Cold run: slot j == req j, so index its comp rows by req.
+        assert np.array_equal(np.asarray(out_warm.hit_found)[hits],
+                              np.asarray(out_cold.comp_found)[hr])
+        assert np.array_equal(np.asarray(out_warm.hit_hops)[hits],
+                              np.asarray(out_cold.comp_hops)[hr])
+
+    def test_completion_ring_drains_exactly_once(self, churned,
+                                                 targets):
+        """A completed slot is reported in exactly one macro step's
+        completion ring and freed after: an idle follow-up macro
+        reports zero completions and zero admissions."""
+        eng = ResidentServeEngine(churned, CFG, slots=L, admit_cap=L,
+                                  ring_slots=2 * L)
+        r_res, st, out1 = resident_closed_loop_replay(
+            churned, CFG, targets, jax.random.PRNGKey(2), engine=eng)
+        n_done = int(np.asarray(out1.comp).sum())
+        assert n_done > 0
+        pad_k = jnp.zeros((L, 5), jnp.uint32)
+        pad_i = jnp.full((L,), -1, jnp.int32)
+        # Rebuild the rings carry the replay consumed (donated away).
+        rings = eng.empty_rings()
+        rings = rings._replace(head=jnp.int32(L), tail=jnp.int32(L))
+        _, _, out2 = eng.macro_step(st, rings, pad_k, pad_i, pad_i,
+                                    jax.random.PRNGKey(3), 0, 1,
+                                    rounds=CFG.max_steps)
+        assert int(np.asarray(out2.comp).sum()) == 0
+        assert int(out2.adm) == 0 and int(out2.hits) == 0
+
+    def test_constructor_validation(self, churned):
+        with pytest.raises(ValueError, match="ring_slots"):
+            ResidentServeEngine(churned, CFG, slots=64, admit_cap=64,
+                                ring_slots=100)
+        with pytest.raises(ValueError, match="rounds_per_iter"):
+            ResidentServeEngine(churned, CFG, slots=64,
+                                rounds_per_iter=0)
+
+
+class TestResidentOpenLoop:
+    """serve_resident — the double-buffered open-loop driver: request
+    conservation, the ring's own conservation identity, zero device
+    sheds under the hand-off throttle, and the shed/queue admission
+    policies riding the resident ring."""
+
+    def _run(self, swarm, rate=400, duration=0.5, key_pool=64,
+             cache_slots=0, admission=None, **eng_kw):
+        ts, keys, klass = poisson_zipf_events(
+            rate=rate, duration=duration, key_pool=key_pool,
+            zipf_s=1.3, seed=5)
+        eng = ResidentServeEngine(swarm, CFG, slots=128, admit_cap=32,
+                                  cache_slots=cache_slots, **eng_kw)
+        c1, s1 = virtual_clock()
+        rep = serve_resident(eng, ts, keys, jax.random.PRNGKey(3),
+                             klass=klass, duration=duration,
+                             admission=admission, clock=c1, sleep=s1)
+        return rep, len(ts)
+
+    def test_conservation_and_resident_block(self, swarm):
+        rep, n = self._run(swarm)
+        assert rep["admitted"] == rep["completed"] + rep["expired"] \
+            + rep["in_flight"]
+        assert rep["admitted"] + rep["shed"] + rep["never_admitted"] \
+            == n
+        res = rep["resident"]
+        assert res["iterations"] >= 1
+        assert res["device_rounds"] >= res["iterations"]
+        # Ring conservation: every enqueued row is admitted (incl.
+        # cache hits), still queued on device, or device-shed.
+        assert res["ring_enqueued"] == rep["admitted"] \
+            + res["ring_backlog_final"] + res["ring_shed"]
+        # The hand-off throttle proves space: the device NEVER sheds.
+        assert res["ring_shed"] == 0
+        assert res["ring_backlog_final"] <= rep["never_admitted"]
+        assert 0 <= res["ring_depth_mean"] <= res["ring_depth_max"]
+        assert res["ring_depth_max"] <= res["ring_slots"]
+        assert 0.0 <= res["host_orchestration_frac"] <= 1.0
+        assert res["exchange"]["rows_init"] == 0      # local engine
+
+    def test_cache_hits_through_resident_ring(self, swarm):
+        rep, _ = self._run(swarm, key_pool=16, cache_slots=128)
+        assert rep["cache_hits"] > 0
+        assert rep["cache_hits"] + rep["cache_misses"] \
+            == rep["admitted"]
+        res = rep["resident"]
+        assert res["ring_enqueued"] == rep["admitted"] \
+            + res["ring_backlog_final"] + res["ring_shed"]
+
+    def test_shed_policy_host_side_device_never_sheds(self, swarm):
+        rep, n = self._run(
+            swarm, rate=4000, duration=0.25,
+            admission=AdmissionControl(rate=300, policy="shed"))
+        assert rep["shed"] > 0
+        assert rep["resident"]["ring_shed"] == 0
+        assert rep["admitted"] + rep["shed"] + rep["never_admitted"] \
+            == n
+
+    def test_degrade_policy_rejected(self, swarm):
+        ts, keys, _ = poisson_zipf_events(rate=100, duration=0.1,
+                                          key_pool=8, zipf_s=1.1,
+                                          seed=5)
+        eng = ResidentServeEngine(swarm, CFG, slots=64, admit_cap=32,
+                                  cache_slots=64)
+        with pytest.raises(ValueError, match="degrade"):
+            serve_resident(eng, ts, keys, jax.random.PRNGKey(3),
+                           admission=AdmissionControl(
+                               rate=50, policy="degrade"))
+
+
+class TestShardedResident:
+    """The resident program on the 8-device mesh: routed replay
+    bit-identical to ``sharded_lookup`` through the slimmed return
+    leg, and mesh cache hits provably skipping the ``all_to_all``
+    (the ``xchg_init_rows`` counter)."""
+
+    @pytest.fixture(scope="class")
+    def mesh8(self):
+        from opendht_tpu.parallel import make_mesh
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        return make_mesh(8)
+
+    @pytest.fixture(scope="class")
+    def setup(self, mesh8):
+        cfg = SwarmConfig.for_nodes(8192)
+        sw = build_swarm(jax.random.PRNGKey(0), cfg)
+        sw = churn(sw, jax.random.PRNGKey(9), 0.3, cfg)
+        tg = jax.random.bits(jax.random.PRNGKey(1), (256, 5),
+                             jnp.uint32)
+        return cfg, sw, tg
+
+    def test_replay_bit_identical_to_sharded_lookup(self, mesh8,
+                                                    setup):
+        from opendht_tpu.parallel.sharded import sharded_lookup
+        cfg, sw, tg = setup
+        r_batch = sharded_lookup(sw, cfg, tg, jax.random.PRNGKey(2),
+                                 mesh8, 2.0, compact=False)
+        eng = ShardedResidentServeEngine(sw, cfg, tg.shape[0], mesh8,
+                                         admit_cap=tg.shape[0],
+                                         ring_slots=2 * tg.shape[0])
+        r_res, st, out = resident_closed_loop_replay(
+            sw, cfg, tg, jax.random.PRNGKey(2), engine=eng)
+        assert _res_equal(r_res, r_batch)
+        # Cache off: EVERY admission row rode the routed exchange.
+        assert int(out.xchg_init_rows) == tg.shape[0]
+        assert int(out.xchg_round_rows) > 0
+
+    def test_mesh_cache_hits_skip_all_to_all(self, mesh8, setup):
+        """The acceptance counter: a warm macro's hit rows are
+        answered BEFORE the routed init, so xchg_init_rows counts only
+        the misses — mesh cache hits never ride the a2a."""
+        cfg, sw, tg = setup
+        n = tg.shape[0]
+        eng = ShardedResidentServeEngine(sw, cfg, n, mesh8,
+                                         admit_cap=n,
+                                         ring_slots=2 * n,
+                                         cache_slots=512)
+        reqs = jnp.arange(n, dtype=jnp.int32)
+        cls = jnp.zeros((n,), jnp.int32)
+        st, rings = eng.empty(), eng.empty_rings()
+        st, rings, out1 = eng.macro_step(
+            st, rings, tg, reqs, cls, jax.random.PRNGKey(2), n, 0,
+            rounds=cfg.max_steps, expire=False)
+        assert int(out1.hits) == 0
+        assert int(out1.xchg_init_rows) == n
+        st, rings, out2 = eng.macro_step(
+            st, rings, tg, reqs, cls, jax.random.PRNGKey(2), n,
+            cfg.max_steps, rounds=cfg.max_steps, expire=False)
+        hits = np.asarray(out2.hit)
+        n_hits = int(hits.sum())
+        assert n_hits > 0
+        assert int(out2.adm) == n - n_hits
+        # THE counter: only miss rows rode the exchange this macro.
+        assert int(out2.xchg_init_rows) == n - n_hits
+        # Hit payloads are the cold run's completions, bit-exact.
+        hr = np.asarray(out2.hit_req)[hits]
+        assert np.array_equal(np.asarray(out2.hit_found)[hits],
+                              np.asarray(out1.comp_found)[hr])
+        assert np.array_equal(np.asarray(out2.hit_hops)[hits],
+                              np.asarray(out1.comp_hops)[hr])
+
+    def test_divisibility_rejected(self, mesh8, setup):
+        cfg, sw, _ = setup
+        with pytest.raises(ValueError, match="divide"):
+            ShardedResidentServeEngine(sw, cfg, 250, mesh8)
+        with pytest.raises(ValueError, match="divide"):
+            ShardedResidentServeEngine(sw, cfg, 256, mesh8,
+                                       admit_cap=100)
+
+
+class TestSoakMaintenanceRing:
+    """Soak maintenance admission through the resident ring: keys
+    gather on device from the sweep pool, the request index encodes
+    the pool row as ``-2 - pool_idx``, and maintenance rows queue
+    FIFO behind earlier serve traffic."""
+
+    def test_encoding_gather_and_fifo(self):
+        from opendht_tpu.models.soak import (WC_REPUB,
+                                             _ring_enqueue_maintenance)
+        cfg = SwarmConfig.for_nodes(64)
+        st = ServeEngine(build_swarm(jax.random.PRNGKey(5), cfg),
+                         cfg, slots=16).empty()
+        pool = jax.random.bits(jax.random.PRNGKey(6), (16, 5),
+                               jnp.uint32)
+        rings = empty_serve_rings(16, 32)
+        # 4 client rows first...
+        ck = jax.random.bits(jax.random.PRNGKey(7), (4, 5), jnp.uint32)
+        rings = _ring_enqueue(rings, ck,
+                              jnp.arange(4, dtype=jnp.int32),
+                              jnp.zeros((4,), jnp.int32), jnp.int32(4))
+        # ...then a maintenance micro-batch from pool rows 3,7,1,15.
+        idx = jnp.array([3, 7, 1, 15], jnp.int32)
+        rings = _ring_enqueue_maintenance(rings, pool, idx,
+                                          jnp.int32(4),
+                                          jnp.int32(WC_REPUB))
+        rings, pkeys, preq, pcls, cand, valid = _ring_pop(st, rings, 8)
+        assert np.asarray(valid).all()
+        # FIFO: serve rows pop strictly ahead of maintenance rows.
+        assert np.asarray(preq)[:4].tolist() == [0, 1, 2, 3]
+        assert np.asarray(pcls)[:4].tolist() == [0, 0, 0, 0]
+        m_req = np.asarray(preq)[4:]
+        assert (m_req <= -2).all()
+        # Decode contract: pool_idx = -2 - comp_req.
+        assert (-2 - m_req).tolist() == [3, 7, 1, 15]
+        assert np.asarray(pcls)[4:].tolist() == [WC_REPUB] * 4
+        assert np.array_equal(np.asarray(pkeys)[4:],
+                              np.asarray(pool)[np.asarray(idx)])
+
+
+class TestResidentChecker:
+    """check_serve_obj's resident block: ring conservation, depth
+    bounds, the recorded host-orchestration budget, and the rung-count
+    identity — pass and fail fixtures."""
+
+    def _artifact(self):
+        a = TestServeChecker._artifact(TestServeChecker())
+        a["bench"]["serve_engine"] = "resident"
+        a["resident"] = {
+            "ring_slots": 128, "rounds_per_iter": 2,
+            "iterations": 40, "device_rounds": 80,
+            "ring_enqueued": 100, "ring_shed": 0,
+            "ring_backlog_final": 0,
+            "ring_depth_mean": 2.5, "ring_depth_max": 31,
+            "host_orchestration_frac": 0.031,
+            "host_orchestration_budget": 0.05,
+            "rung_select": 0, "in_jit_rung_counts": [80],
+            "exchange": {"rows_init": 0, "rows_round": 0,
+                         "row_bytes": 0},
+        }
+        return a
+
+    def test_valid_resident_artifact_passes(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        assert check_serve_obj(self._artifact()) == []
+
+    def test_missing_block_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        del a["resident"]
+        errs = check_serve_obj(a)
+        assert any("no resident block" in e for e in errs), errs
+
+    def test_ring_conservation_violation_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        a["resident"]["ring_enqueued"] = 103
+        errs = check_serve_obj(a)
+        assert any("ring does not conserve" in e for e in errs), errs
+
+    def test_backlog_over_never_admitted_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        # Conservation holds (enqueued grows too) but the queued rows
+        # were never booked never-admitted.
+        a["resident"]["ring_backlog_final"] = 3
+        a["resident"]["ring_enqueued"] = 103
+        errs = check_serve_obj(a)
+        assert any("never_admitted" in e for e in errs), errs
+
+    def test_depth_over_ring_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        a["resident"]["ring_depth_max"] = 129
+        errs = check_serve_obj(a)
+        assert any("ring_depth_max" in e for e in errs), errs
+
+    def test_orchestration_over_budget_flagged(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        a["resident"]["host_orchestration_frac"] = 0.07
+        errs = check_serve_obj(a)
+        assert any("budget" in e for e in errs), errs
+
+    def test_rung_count_sum_gated(self):
+        from opendht_tpu.tools.check_trace import check_serve_obj
+        a = self._artifact()
+        a["resident"]["rung_select"] = 8
+        a["resident"]["in_jit_rung_counts"] = [20, 20, 20, 20]
+        assert check_serve_obj(a) == []
+        a["resident"]["in_jit_rung_counts"] = [20, 20, 20, 19]
+        errs = check_serve_obj(a)
+        assert any("rung" in e for e in errs), errs
